@@ -1,0 +1,385 @@
+"""Dataset and Booster: the core user-facing classes.
+
+API mirrors the reference python package (python-package/lightgbm/basic.py:
+Dataset:1692, Booster:3495) with the ctypes/C-API layer replaced by direct
+calls into the JAX/NumPy core. Dataset keeps the reference's lazy-construction
+semantics: raw data is held until `construct()` bins it (against an optional
+reference dataset so validation bins align, basic.py _lazy_init).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config, resolve_params
+from .data.dataset import BinnedDataset, construct_from_matrix
+from .metrics import Metric, create_metric, default_metric_for_objective
+from .models.gbdt import GBDT
+from .objectives import create_objective
+from .utils.log import log_fatal, log_info, log_warning
+
+
+def _to_2d_numpy(data: Any) -> np.ndarray:
+    if hasattr(data, "values"):   # pandas DataFrame
+        data = data.values
+    arr = np.asarray(data)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    return arr
+
+
+class Dataset:
+    """Dataset container (reference: basic.py:1692)."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name: Union[str, List[str]] = "auto",
+                 categorical_feature: Union[str, List[int], List[str]] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True, position=None):
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = copy.deepcopy(params) if params else {}
+        self.free_raw_data = free_raw_data
+        self._handle: Optional[BinnedDataset] = None
+        self.used_indices: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------------
+    def construct(self) -> "Dataset":
+        if self._handle is not None:
+            return self
+        cfg = resolve_params(self.params)
+        data = _to_2d_numpy(self.data)
+        n_cols = data.shape[1]
+
+        feature_names: Optional[List[str]] = None
+        if isinstance(self.feature_name, list):
+            feature_names = list(self.feature_name)
+        elif hasattr(self.data, "columns"):
+            feature_names = [str(c) for c in self.data.columns]
+
+        cat_indices: List[int] = []
+        cats = self.categorical_feature
+        if cats == "auto" or cats is None:
+            cat_indices = []
+        elif isinstance(cats, str):
+            cat_indices = [int(c) for c in cats.split(",") if c]
+        else:
+            for c in cats:
+                if isinstance(c, str):
+                    if feature_names and c in feature_names:
+                        cat_indices.append(feature_names.index(c))
+                else:
+                    cat_indices.append(int(c))
+
+        ref_handle = None
+        if self.reference is not None:
+            self.reference.construct()
+            ref_handle = self.reference._handle
+
+        label = None if self.label is None else np.asarray(self.label)
+        weight = None if self.weight is None else np.asarray(self.weight)
+        group = None if self.group is None else np.asarray(self.group)
+        init_score = None if self.init_score is None else np.asarray(
+            self.init_score)
+
+        self._handle = construct_from_matrix(
+            data, cfg, label=label, weight=weight, group=group,
+            init_score=init_score, categorical_feature=cat_indices,
+            feature_names=feature_names, reference=ref_handle)
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None, position=None) -> "Dataset":
+        """reference: basic.py Dataset.create_valid."""
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score,
+                       params=params if params is not None else self.params)
+
+    # -- introspection -------------------------------------------------
+    def num_data(self) -> int:
+        self.construct()
+        return self._handle.num_data
+
+    def num_feature(self) -> int:
+        self.construct()
+        return self._handle.num_total_features
+
+    def get_label(self) -> Optional[np.ndarray]:
+        if self._handle is not None:
+            return self._handle.metadata.label
+        return None if self.label is None else np.asarray(self.label)
+
+    def get_weight(self) -> Optional[np.ndarray]:
+        if self._handle is not None:
+            return self._handle.metadata.weight
+        return None if self.weight is None else np.asarray(self.weight)
+
+    def get_group(self) -> Optional[np.ndarray]:
+        if self._handle is not None and self._handle.metadata.query_boundaries is not None:
+            return np.diff(self._handle.metadata.query_boundaries)
+        return None if self.group is None else np.asarray(self.group)
+
+    def get_init_score(self):
+        return self.init_score
+
+    def get_feature_name(self) -> List[str]:
+        self.construct()
+        return list(self._handle.feature_names)
+
+    def set_label(self, label) -> "Dataset":
+        self.label = label
+        if self._handle is not None:
+            self._handle.metadata.set_label(
+                None if label is None else np.asarray(label))
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = weight
+        if self._handle is not None:
+            self._handle.metadata.set_weight(
+                None if weight is None else np.asarray(weight))
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = group
+        if self._handle is not None:
+            self._handle.metadata.set_group(
+                None if group is None else np.asarray(group))
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = init_score
+        if self._handle is not None:
+            self._handle.metadata.set_init_score(
+                None if init_score is None else np.asarray(init_score))
+        return self
+
+    def save_binary(self, filename: str) -> "Dataset":
+        """Binary dataset cache (reference: LGBM_DatasetSaveBinary,
+        c_api.h:540). Stored as an npz with mapper metadata."""
+        import json
+        self.construct()
+        h = self._handle
+        np.savez_compressed(
+            filename,
+            X_binned=h.X_binned,
+            label=h.metadata.label if h.metadata.label is not None else np.zeros(0),
+            weight=h.metadata.weight if h.metadata.weight is not None else np.zeros(0),
+            query_boundaries=(h.metadata.query_boundaries
+                              if h.metadata.query_boundaries is not None
+                              else np.zeros(0)),
+            mappers=json.dumps([m.to_dict() for m in h.mappers]),
+            real_feature_index=np.asarray(h.real_feature_index),
+            used_feature_map=np.asarray(h.used_feature_map),
+            feature_names=json.dumps(h.feature_names),
+            num_total_features=h.num_total_features,
+        )
+        return self
+
+
+class Booster:
+    """Booster (reference: basic.py:3495)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        self.params = copy.deepcopy(params) if params else {}
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._train_metrics: List[Metric] = []
+        self._valid_metrics: List[List[Metric]] = []
+        self.name_valid_sets: List[str] = []
+
+        if train_set is not None:
+            cfg = resolve_params(self.params)
+            train_set.params = {**train_set.params, **self.params} \
+                if train_set._handle is None else train_set.params
+            train_set.construct()
+            objective = create_objective(cfg)
+            metric_names = cfg.metric or [default_metric_for_objective(
+                cfg.objective)]
+            self._train_metrics = [
+                m for m in (create_metric(n, cfg) for n in metric_names)
+                if m is not None]
+            self._gbdt = GBDT(cfg, train_set._handle, objective,
+                              self._train_metrics)
+            self.train_set = train_set
+            self._config = cfg
+            self._metric_names = metric_names
+        elif model_file is not None:
+            with open(model_file) as f:
+                model_str = f.read()
+            self._gbdt = GBDT.load_model_from_string(model_str)
+            self._config = self._gbdt.config
+        elif model_str is not None:
+            self._gbdt = GBDT.load_model_from_string(model_str)
+            self._config = self._gbdt.config
+        else:
+            raise ValueError("need at least one of train_set, model_file "
+                             "and model_str")
+
+    # ------------------------------------------------------------------
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        if data.reference is None or data.reference is not self.train_set:
+            data.reference = self.train_set
+        data.construct()
+        metrics = [m for m in (create_metric(n, self._config)
+                               for n in self._metric_names) if m is not None]
+        self._gbdt.add_valid_dataset(data._handle, name, metrics)
+        self._valid_metrics.append(metrics)
+        self.name_valid_sets.append(name)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None,
+               fobj=None) -> bool:
+        """One boosting iteration (reference: basic.py:4005). Returns True
+        when no further splits are possible."""
+        if fobj is not None:
+            K = self._gbdt.num_tree_per_iteration
+            score = self.__inner_raw_score()
+            grad, hess = fobj(score, self.train_set)
+            return self._gbdt.train_one_iter(np.asarray(grad),
+                                             np.asarray(hess))
+        return self._gbdt.train_one_iter()
+
+    def __inner_raw_score(self) -> np.ndarray:
+        import jax
+        s = np.asarray(jax.device_get(self._gbdt.scores))
+        return s[0] if s.shape[0] == 1 else s.reshape(-1)
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    @property
+    def current_iteration(self):
+        return self._gbdt.iter
+
+    def num_trees(self) -> int:
+        return len(self._gbdt.models)
+
+    def num_model_per_iteration(self) -> int:
+        return self._gbdt.num_tree_per_iteration
+
+    def num_feature(self) -> int:
+        return self._gbdt.max_feature_idx_ + 1
+
+    def feature_name(self) -> List[str]:
+        return list(self._gbdt.feature_names_)
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        t = 0 if importance_type == "split" else 1
+        imp = self._gbdt.feature_importance(t, iteration or -1)
+        return imp if t else imp.astype(np.int64)
+
+    # ------------------------------------------------------------------
+    def eval_train(self, feval=None) -> List:
+        return self.__eval("training", feval)
+
+    def eval_valid(self, feval=None) -> List:
+        out = []
+        for name in self.name_valid_sets:
+            out.extend(self.__eval(name, feval))
+        return out
+
+    def eval(self, data: Dataset, name: str, feval=None) -> List:
+        if name == "training":
+            return self.eval_train(feval)
+        return self.__eval(name, feval)
+
+    def __eval(self, name: str, feval=None) -> List:
+        if name == "training":
+            metrics = {name: self._train_metrics}
+        else:
+            vi = self.name_valid_sets.index(name)
+            metrics = {name: self._valid_metrics[vi]}
+        res = self._gbdt.get_eval_result(metrics)
+        if feval is not None:
+            import jax
+            if name == "training":
+                score = np.asarray(jax.device_get(self._gbdt.scores))
+                dataset = self.train_set
+            else:
+                vi = self.name_valid_sets.index(name)
+                score = np.asarray(
+                    jax.device_get(self._gbdt._valid_scores[vi]))
+                dataset = None
+            s = score[0] if score.shape[0] == 1 else score.reshape(-1)
+            ret = feval(s, dataset)
+            if ret is not None:
+                if isinstance(ret, tuple):
+                    ret = [ret]
+                for mn, val, hib in ret:
+                    res.append((name, mn, val, hib))
+        return res
+
+    # ------------------------------------------------------------------
+    def predict(self, data, start_iteration: int = 0,
+                num_iteration: Optional[int] = None,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        data = _to_2d_numpy(data)
+        ni = num_iteration if num_iteration is not None else (
+            self.best_iteration if self.best_iteration > 0 else -1)
+        if pred_leaf:
+            return self._gbdt.predict_leaf_index(data, start_iteration, ni)
+        if pred_contrib:
+            from .models.shap import predict_contrib
+            return predict_contrib(self._gbdt, data, start_iteration, ni)
+        return self._gbdt.predict(data, raw_score=raw_score,
+                                  start_iteration=start_iteration,
+                                  num_iteration=ni)
+
+    # ------------------------------------------------------------------
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0,
+                   importance_type: str = "split") -> "Booster":
+        with open(filename, "w") as f:
+            f.write(self.model_to_string(num_iteration, start_iteration,
+                                         importance_type))
+        return self
+
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0,
+                        importance_type: str = "split") -> str:
+        ni = num_iteration if num_iteration is not None else (
+            self.best_iteration if self.best_iteration > 0 else -1)
+        s = self._gbdt.save_model_to_string(
+            start_iteration, ni, 0 if importance_type == "split" else 1)
+        return s + "\npandas_categorical:null\n"
+
+    def model_from_string(self, model_str: str) -> "Booster":
+        self._gbdt = GBDT.load_model_from_string(model_str)
+        return self
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """reference: basic.py Booster.reset_parameter (supports the
+        reset_parameter callback: learning-rate schedules etc.)."""
+        self.params.update(params)
+        cfg = resolve_params(self.params)
+        self._gbdt.config = cfg
+        self._gbdt.shrinkage_rate = cfg.learning_rate
+        return self
+
+    def __copy__(self):
+        return self
+
+    def free_dataset(self) -> "Booster":
+        return self
+
+    def free_network(self) -> "Booster":
+        return self

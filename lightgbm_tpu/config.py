@@ -1,0 +1,447 @@
+"""Hyperparameter configuration.
+
+TPU-native analog of the reference Config struct (include/LightGBM/config.h:41,
+src/io/config.cpp, generated alias table src/io/config_auto.cpp). One dataclass
+holds every parameter; `resolve_params` applies the alias table and type
+coercion so params flow as {key: value} dicts through every API layer exactly
+like the reference's key=value strings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Union
+
+from .utils.log import log_fatal, log_warning
+
+# ---------------------------------------------------------------------------
+# Alias table: alias -> canonical name. Mirrors the semantics of the
+# reference's Config::alias_table (src/io/config_auto.cpp) — many aliases per
+# canonical parameter, resolved before type parsing.
+# ---------------------------------------------------------------------------
+_ALIASES: Dict[str, str] = {}
+
+
+def _alias(canonical: str, *aliases: str) -> None:
+    for a in aliases:
+        _ALIASES[a] = canonical
+
+
+_alias("config", "config_file")
+_alias("objective", "objective_type", "app", "application", "loss")
+_alias("boosting", "boosting_type", "boost")
+_alias("data_sample_strategy", "sample_strategy")
+_alias("data", "train", "train_data", "train_data_file", "data_filename")
+_alias("valid", "test", "valid_data", "valid_data_file", "test_data",
+       "test_data_file", "valid_filenames")
+_alias("num_iterations", "num_iteration", "n_iter", "num_tree", "num_trees",
+       "num_round", "num_rounds", "nrounds", "num_boost_round", "n_estimators",
+       "max_iter")
+_alias("learning_rate", "shrinkage_rate", "eta")
+_alias("num_leaves", "num_leaf", "max_leaves", "max_leaf", "max_leaf_nodes")
+_alias("tree_learner", "tree", "tree_type", "tree_learner_type")
+_alias("num_threads", "num_thread", "nthread", "nthreads", "n_jobs")
+_alias("device_type", "device")
+_alias("seed", "random_seed", "random_state")
+_alias("min_data_in_leaf", "min_data_per_leaf", "min_data",
+       "min_child_samples", "min_samples_leaf")
+_alias("min_sum_hessian_in_leaf", "min_sum_hessian_per_leaf",
+       "min_sum_hessian", "min_hessian", "min_child_weight")
+_alias("bagging_fraction", "sub_row", "subsample", "bagging")
+_alias("pos_bagging_fraction", "pos_sub_row", "pos_subsample", "pos_bagging")
+_alias("neg_bagging_fraction", "neg_sub_row", "neg_subsample", "neg_bagging")
+_alias("bagging_freq", "subsample_freq")
+_alias("bagging_seed", "bagging_fraction_seed")
+_alias("feature_fraction", "sub_feature", "colsample_bytree")
+_alias("feature_fraction_bynode", "sub_feature_bynode", "colsample_bynode")
+_alias("extra_trees", "extra_tree")
+_alias("early_stopping_round", "early_stopping_rounds", "early_stopping",
+       "n_iter_no_change")
+_alias("max_delta_step", "max_tree_output", "max_leaf_output")
+_alias("lambda_l1", "reg_alpha", "l1_regularization")
+_alias("lambda_l2", "reg_lambda", "lambda", "l2_regularization")
+_alias("min_gain_to_split", "min_split_gain")
+_alias("drop_rate", "rate_drop")
+_alias("uniform_drop", "uniform_dart")
+_alias("max_cat_threshold", "max_cat_threshold")
+_alias("min_data_per_group", "min_data_per_group")
+_alias("monotone_constraints", "mc", "monotone_constraint")
+_alias("monotone_constraints_method", "monotone_constraining_method",
+       "mc_method")
+_alias("monotone_penalty", "monotone_splits_penalty", "ms_penalty",
+       "mc_penalty")
+_alias("feature_contri", "feature_contrib", "fc", "fp", "feature_penalty")
+_alias("forcedsplits_filename", "fs", "forced_splits_filename",
+       "forced_splits_file", "forced_splits")
+_alias("refit_decay_rate", "refit_decay_rate")
+_alias("interaction_constraints", "interaction_constraints")
+_alias("verbosity", "verbose")
+_alias("input_model", "model_input", "model_in")
+_alias("output_model", "model_output", "model_out")
+_alias("saved_feature_importance_type", "saved_feature_importance_type")
+_alias("snapshot_freq", "save_period")
+_alias("max_bin", "max_bins")
+_alias("max_bin_by_feature", "max_bin_by_feature")
+_alias("min_data_in_bin", "min_data_in_bin")
+_alias("bin_construct_sample_cnt", "bin_construct_sample_cnt",
+       "subsample_for_bin")
+_alias("data_random_seed", "data_seed")
+_alias("is_enable_sparse", "is_sparse", "enable_sparse", "sparse")
+_alias("enable_bundle", "is_enable_bundle", "bundle")
+_alias("use_missing", "use_missing")
+_alias("zero_as_missing", "zero_as_missing")
+_alias("feature_pre_filter", "feature_pre_filter")
+_alias("pre_partition", "is_pre_partition")
+_alias("two_round", "two_round_loading", "use_two_round_loading")
+_alias("header", "has_header")
+_alias("label_column", "label")
+_alias("weight_column", "weight")
+_alias("group_column", "group", "group_id", "query_column", "query",
+       "query_id")
+_alias("ignore_column", "ignore_feature", "blacklist")
+_alias("categorical_feature", "cat_feature", "categorical_column",
+       "cat_column", "categorical_features")
+_alias("forcedbins_filename", "forcedbins_filename")
+_alias("predict_raw_score", "is_predict_raw_score", "predict_rawscore",
+       "raw_score")
+_alias("predict_leaf_index", "is_predict_leaf_index", "leaf_index")
+_alias("predict_contrib", "is_predict_contrib", "contrib")
+_alias("predict_disable_shape_check", "predict_disable_shape_check")
+_alias("pred_early_stop", "pred_early_stop")
+_alias("pred_early_stop_freq", "pred_early_stop_freq")
+_alias("pred_early_stop_margin", "pred_early_stop_margin")
+_alias("output_result", "predict_result", "prediction_result",
+       "predict_name", "prediction_name", "pred_name", "name_pred")
+_alias("num_class", "num_classes")
+_alias("is_unbalance", "unbalance", "unbalanced_sets", "unbalanced")
+_alias("scale_pos_weight", "scale_pos_weight")
+_alias("boost_from_average", "boost_from_average")
+_alias("reg_sqrt", "reg_sqrt")
+_alias("alpha", "alpha")
+_alias("fair_c", "fair_c")
+_alias("poisson_max_delta_step", "poisson_max_delta_step")
+_alias("tweedie_variance_power", "tweedie_variance_power")
+_alias("lambdarank_truncation_level", "lambdarank_truncation_level")
+_alias("lambdarank_norm", "lambdarank_norm")
+_alias("label_gain", "label_gain")
+_alias("metric", "metrics", "metric_types")
+_alias("metric_freq", "output_freq")
+_alias("is_provide_training_metric", "training_metric",
+       "is_training_metric", "train_metric")
+_alias("eval_at", "ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at")
+_alias("num_machines", "num_machine")
+_alias("local_listen_port", "local_port", "port")
+_alias("time_out", "time_out")
+_alias("machine_list_filename", "machine_list_file", "machine_list",
+       "mlist")
+_alias("machines", "workers", "nodes")
+_alias("gpu_platform_id", "gpu_platform_id")
+_alias("gpu_device_id", "gpu_device_id")
+_alias("gpu_use_dp", "gpu_use_dp")
+_alias("num_gpu", "num_gpus")
+
+
+@dataclass
+class Config:
+    """All hyperparameters (reference: include/LightGBM/config.h:41).
+
+    Defaults match the reference's documented defaults. `device_type` gains
+    the value "tpu" (the point of this project); "cpu" maps to running the
+    same XLA graphs on the host platform.
+    """
+
+    # -- core
+    task: str = "train"
+    objective: str = "regression"
+    boosting: str = "gbdt"
+    data_sample_strategy: str = "bagging"
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    num_leaves: int = 31
+    tree_learner: str = "serial"
+    num_threads: int = 0
+    device_type: str = "tpu"
+    seed: Optional[int] = None
+    deterministic: bool = False
+
+    # -- learning control
+    force_col_wise: bool = False
+    force_row_wise: bool = False
+    histogram_pool_size: float = -1.0
+    max_depth: int = -1
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    bagging_fraction: float = 1.0
+    pos_bagging_fraction: float = 1.0
+    neg_bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    bagging_seed: int = 3
+    bagging_by_query: bool = False
+    feature_fraction: float = 1.0
+    feature_fraction_bynode: float = 1.0
+    feature_fraction_seed: int = 2
+    extra_trees: bool = False
+    extra_seed: int = 6
+    early_stopping_round: int = 0
+    early_stopping_min_delta: float = 0.0
+    first_metric_only: bool = False
+    max_delta_step: float = 0.0
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    linear_lambda: float = 0.0
+    min_gain_to_split: float = 0.0
+    drop_rate: float = 0.1
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    xgboost_dart_mode: bool = False
+    uniform_drop: bool = False
+    drop_seed: int = 4
+    top_rate: float = 0.2
+    other_rate: float = 0.1
+    min_data_per_group: int = 100
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_to_onehot: int = 4
+    top_k: int = 20
+    monotone_constraints: List[int] = field(default_factory=list)
+    monotone_constraints_method: str = "basic"
+    monotone_penalty: float = 0.0
+    feature_contri: List[float] = field(default_factory=list)
+    forcedsplits_filename: str = ""
+    refit_decay_rate: float = 0.9
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
+    cegb_penalty_feature_lazy: List[float] = field(default_factory=list)
+    cegb_penalty_feature_coupled: List[float] = field(default_factory=list)
+    path_smooth: float = 0.0
+    interaction_constraints: Union[str, List[List[int]]] = ""
+    verbosity: int = 1
+    input_model: str = ""
+    output_model: str = "LightGBM_model.txt"
+    saved_feature_importance_type: int = 0
+    snapshot_freq: int = -1
+    use_quantized_grad: bool = False
+    num_grad_quant_bins: int = 4
+    quant_train_renew_leaf: bool = False
+    stochastic_rounding: bool = True
+
+    # -- dataset
+    linear_tree: bool = False
+    max_bin: int = 255
+    max_bin_by_feature: List[int] = field(default_factory=list)
+    min_data_in_bin: int = 3
+    bin_construct_sample_cnt: int = 200000
+    data_random_seed: int = 1
+    is_enable_sparse: bool = True
+    enable_bundle: bool = True
+    use_missing: bool = True
+    zero_as_missing: bool = False
+    feature_pre_filter: bool = True
+    pre_partition: bool = False
+    two_round: bool = False
+    header: bool = False
+    label_column: str = ""
+    weight_column: str = ""
+    group_column: str = ""
+    ignore_column: str = ""
+    categorical_feature: Union[str, List[int], List[str]] = ""
+    forcedbins_filename: str = ""
+    save_binary: bool = False
+    precise_float_parser: bool = False
+    parser_config_file: str = ""
+
+    # -- predict
+    start_iteration_predict: int = 0
+    num_iteration_predict: int = -1
+    predict_raw_score: bool = False
+    predict_leaf_index: bool = False
+    predict_contrib: bool = False
+    predict_disable_shape_check: bool = False
+    pred_early_stop: bool = False
+    pred_early_stop_freq: int = 10
+    pred_early_stop_margin: float = 10.0
+    output_result: str = "LightGBM_predict_result.txt"
+
+    # -- convert
+    convert_model_language: str = ""
+    convert_model: str = "gbdt_prediction.cpp"
+
+    # -- objective
+    objective_seed: int = 5
+    num_class: int = 1
+    is_unbalance: bool = False
+    scale_pos_weight: float = 1.0
+    sigmoid: float = 1.0
+    boost_from_average: bool = True
+    reg_sqrt: bool = False
+    alpha: float = 0.9
+    fair_c: float = 1.0
+    poisson_max_delta_step: float = 0.7
+    tweedie_variance_power: float = 1.5
+    lambdarank_truncation_level: int = 30
+    lambdarank_norm: bool = True
+    label_gain: List[float] = field(default_factory=list)
+    lambdarank_position_bias_regularization: float = 0.0
+
+    # -- metric
+    metric: List[str] = field(default_factory=list)
+    metric_freq: int = 1
+    is_provide_training_metric: bool = False
+    eval_at: List[int] = field(default_factory=lambda: [1, 2, 3, 4, 5])
+    multi_error_top_k: int = 1
+    auc_mu_weights: List[float] = field(default_factory=list)
+
+    # -- network
+    num_machines: int = 1
+    local_listen_port: int = 12400
+    time_out: int = 120
+    machine_list_filename: str = ""
+    machines: str = ""
+
+    # -- device-specific
+    gpu_platform_id: int = -1
+    gpu_device_id: int = -1
+    gpu_use_dp: bool = False
+    num_gpu: int = 1
+    # TPU-specific knobs (new in this framework)
+    tpu_hist_dtype: str = "float32"    # float32 | bfloat16 | int8 (quantized)
+    tpu_rows_per_block: int = 1024     # pallas histogram kernel row block
+    tpu_num_shards: int = 0            # 0 = use all local devices for data ||
+
+    def __post_init__(self) -> None:
+        self._validate()
+
+    # -- parity with reference Config::CheckParamConflict (src/io/config.cpp)
+    def _validate(self) -> None:
+        if self.num_leaves < 2:
+            log_fatal(f"num_leaves must be >= 2, got {self.num_leaves}")
+        if not (0.0 < self.bagging_fraction <= 1.0):
+            log_fatal("bagging_fraction should be in (0.0, 1.0]")
+        if not (0.0 < self.feature_fraction <= 1.0):
+            log_fatal("feature_fraction should be in (0.0, 1.0]")
+        if not (0.0 < self.feature_fraction_bynode <= 1.0):
+            log_fatal("feature_fraction_bynode should be in (0.0, 1.0]")
+        if self.max_bin <= 1:
+            log_fatal("max_bin should be > 1")
+        if self.num_class < 1:
+            log_fatal("num_class should be >= 1")
+        if self.learning_rate <= 0.0:
+            log_fatal("learning_rate should be > 0.0")
+        if self.boosting == "rf":
+            if self.bagging_freq <= 0 or self.bagging_fraction >= 1.0 or self.bagging_fraction <= 0.0:
+                log_fatal(
+                    "Random forest (boosting=rf) requires 0 < bagging_fraction < 1 "
+                    "and bagging_freq > 0")
+
+    def max_depth_effective(self) -> int:
+        return self.max_depth if self.max_depth > 0 else 10**9
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_string(self) -> str:
+        """Serialize `[key: value]` lines, the reference's Config::ToString
+        layout used inside model files (gbdt_model_text.cpp parameters
+        section)."""
+        lines = []
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, bool):
+                v = int(v)
+            elif isinstance(v, list):
+                v = ",".join(str(x) for x in v)
+            elif v is None:
+                v = ""
+            lines.append(f"[{f.name}: {v}]")
+        return "\n".join(lines)
+
+
+_FIELD_TYPES = {f.name: f for f in dataclasses.fields(Config)}
+
+_BOOSTING_VALUES = {"gbdt", "gbrt", "dart", "rf", "random_forest", "goss"}
+_TREE_LEARNER_VALUES = {
+    "serial", "feature", "feature_parallel", "data", "data_parallel",
+    "voting", "voting_parallel",
+}
+
+
+def _coerce(name: str, value: Any) -> Any:
+    """Parse a raw param value (possibly a string) into the field's type."""
+    f = _FIELD_TYPES[name]
+    ftype = f.type
+    if value is None:
+        return None
+    is_list = str(ftype).startswith("typing.List") or "List" in str(ftype)
+    if is_list and name not in ("categorical_feature", "interaction_constraints"):
+        if isinstance(value, str):
+            value = [v for v in value.replace(",", " ").split() if v]
+        elif not isinstance(value, (list, tuple)):
+            value = [value]
+        if name in ("monotone_constraints", "max_bin_by_feature", "eval_at"):
+            return [int(v) for v in value]
+        if name == "metric":
+            return [str(v) for v in value]
+        return [float(v) for v in value]
+    default = f.default if f.default is not dataclasses.MISSING else None
+    if isinstance(default, bool):
+        if isinstance(value, str):
+            return value.lower() in ("true", "1", "yes", "+")
+        return bool(value)
+    if isinstance(default, int) or name == "seed":
+        return int(value)
+    if isinstance(default, float):
+        return float(value)
+    return value
+
+
+def resolve_params(
+    params: Optional[Dict[str, Any]],
+    **overrides: Any,
+) -> Config:
+    """Apply the alias table and build a Config.
+
+    Mirrors Config::Set (src/io/config.cpp): aliases resolve to canonical
+    names; when both an alias and the canonical name are given the canonical
+    one wins and a warning is emitted.
+    """
+    params = dict(params or {})
+    params.update(overrides)
+    canonical: Dict[str, Any] = {}
+    for key, value in params.items():
+        name = _ALIASES.get(key, key)
+        if name in canonical and canonical[name] != value:
+            log_warning(f"{name} is set multiple times (alias conflict); "
+                        f"keeping {name}={canonical[name]!r}")
+            continue
+        canonical[name] = value
+
+    # normalize enum-ish values
+    if "boosting" in canonical:
+        b = str(canonical["boosting"])
+        if b == "gbrt":
+            b = "gbdt"
+        if b == "random_forest":
+            b = "rf"
+        if b == "goss":  # legacy spelling: boosting=goss
+            b = "gbdt"
+            canonical.setdefault("data_sample_strategy", "goss")
+        canonical["boosting"] = b
+    if "tree_learner" in canonical:
+        t = str(canonical["tree_learner"]).replace("_parallel", "")
+        if t not in {"serial", "feature", "data", "voting"}:
+            log_fatal(f"Unknown tree_learner type {canonical['tree_learner']}")
+        canonical["tree_learner"] = t
+
+    kwargs: Dict[str, Any] = {}
+    unknown: Dict[str, Any] = {}
+    for name, value in canonical.items():
+        if name in _FIELD_TYPES:
+            kwargs[name] = _coerce(name, value)
+        else:
+            unknown[name] = value
+    cfg = Config(**kwargs)
+    if unknown:
+        log_warning(f"Unknown parameters: {sorted(unknown)}")
+    return cfg

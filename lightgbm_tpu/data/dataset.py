@@ -1,0 +1,236 @@
+"""Binned dataset construction.
+
+TPU-native analog of the reference Dataset/DatasetLoader/Metadata
+(include/LightGBM/dataset.h:49-1086, src/io/dataset.cpp,
+src/io/dataset_loader.cpp): sample rows -> per-feature BinMapper -> dense
+binned feature matrix.
+
+TPU-first layout decision: instead of per-feature Bin objects (dense_bin.hpp /
+sparse_bin.hpp) the binned matrix is ONE dense [num_data, num_features] uint8
+(or uint16 when any feature has >256 bins) array pushed to HBM, padded so XLA
+sees static, tile-aligned shapes. Histogram/partition kernels consume it
+directly (ops/histogram.py). Sparse/EFB bundling collapses into this same
+dense layout (features are already "bundled" into one matrix).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..config import Config
+from ..utils.log import log_fatal, log_info, log_warning
+from .binning import (BIN_TYPE_CATEGORICAL, BIN_TYPE_NUMERICAL, BinMapper)
+
+
+class Metadata:
+    """Labels, weights, query boundaries, init scores
+    (reference: include/LightGBM/dataset.h:49-134, src/io/metadata.cpp)."""
+
+    def __init__(self, num_data: int):
+        self.num_data = num_data
+        self.label: Optional[np.ndarray] = None
+        self.weight: Optional[np.ndarray] = None
+        self.query_boundaries: Optional[np.ndarray] = None  # [num_queries+1]
+        self.init_score: Optional[np.ndarray] = None
+
+    def set_label(self, label: Optional[np.ndarray]) -> None:
+        if label is None:
+            self.label = None
+            return
+        label = np.asarray(label, dtype=np.float32).reshape(-1)
+        if len(label) != self.num_data:
+            log_fatal(f"Length of label ({len(label)}) differs from "
+                      f"num_data ({self.num_data})")
+        self.label = label
+
+    def set_weight(self, weight: Optional[np.ndarray]) -> None:
+        if weight is None:
+            self.weight = None
+            return
+        weight = np.asarray(weight, dtype=np.float32).reshape(-1)
+        if len(weight) != self.num_data:
+            log_fatal(f"Length of weight ({len(weight)}) differs from "
+                      f"num_data ({self.num_data})")
+        if np.any(weight < 0):
+            log_fatal("Weights should be non-negative")
+        self.weight = weight
+
+    def set_group(self, group: Optional[np.ndarray]) -> None:
+        """`group` is per-query sizes (reference: Metadata::SetQuery)."""
+        if group is None:
+            self.query_boundaries = None
+            return
+        group = np.asarray(group, dtype=np.int64).reshape(-1)
+        bounds = np.concatenate([[0], np.cumsum(group)])
+        if bounds[-1] != self.num_data:
+            log_fatal(f"Sum of query counts ({bounds[-1]}) differs from "
+                      f"num_data ({self.num_data})")
+        self.query_boundaries = bounds.astype(np.int32)
+
+    def set_init_score(self, init_score: Optional[np.ndarray]) -> None:
+        if init_score is None:
+            self.init_score = None
+            return
+        init_score = np.asarray(init_score, dtype=np.float64)
+        if init_score.ndim == 1 and len(init_score) % self.num_data != 0:
+            log_fatal("init_score length is not a multiple of num_data")
+        self.init_score = init_score
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+
+class BinnedDataset:
+    """The constructed (binned) dataset
+    (reference: Dataset, include/LightGBM/dataset.h:492).
+
+    Attributes
+    ----------
+    X_binned : np.ndarray [num_data, num_features] uint8|uint16
+        Bin index per (row, inner feature).
+    mappers : list[BinMapper], one per *inner* (non-trivial) feature.
+    real_feature_index : inner feature -> original column index.
+    used_feature_map : original column -> inner feature index or -1.
+    """
+
+    def __init__(self) -> None:
+        self.num_data: int = 0
+        self.num_total_features: int = 0
+        self.X_binned: Optional[np.ndarray] = None
+        self.mappers: List[BinMapper] = []
+        self.real_feature_index: List[int] = []
+        self.used_feature_map: List[int] = []
+        self.feature_names: List[str] = []
+        self.metadata: Optional[Metadata] = None
+        self.max_bin: int = 255
+        self.reference: Optional["BinnedDataset"] = None
+
+    # -- derived per-feature arrays consumed by device kernels
+    @property
+    def num_features(self) -> int:
+        return len(self.mappers)
+
+    def feature_num_bins(self) -> np.ndarray:
+        return np.array([m.num_bin for m in self.mappers], dtype=np.int32)
+
+    def feature_missing_types(self) -> np.ndarray:
+        return np.array([m.missing_type for m in self.mappers], dtype=np.int32)
+
+    def feature_default_bins(self) -> np.ndarray:
+        return np.array([m.default_bin for m in self.mappers], dtype=np.int32)
+
+    def feature_is_categorical(self) -> np.ndarray:
+        return np.array([m.bin_type == BIN_TYPE_CATEGORICAL
+                         for m in self.mappers], dtype=bool)
+
+    def feature_infos(self) -> List[str]:
+        infos = []
+        for orig in range(self.num_total_features):
+            inner = self.used_feature_map[orig]
+            infos.append("none" if inner < 0 else self.mappers[inner].feature_info())
+        return infos
+
+    @property
+    def label(self) -> Optional[np.ndarray]:
+        return self.metadata.label if self.metadata else None
+
+
+def construct_from_matrix(
+    data: np.ndarray,
+    config: Config,
+    label: Optional[np.ndarray] = None,
+    weight: Optional[np.ndarray] = None,
+    group: Optional[np.ndarray] = None,
+    init_score: Optional[np.ndarray] = None,
+    categorical_feature: Sequence[int] = (),
+    feature_names: Optional[Sequence[str]] = None,
+    reference: Optional[BinnedDataset] = None,
+) -> BinnedDataset:
+    """Build a BinnedDataset from a raw [num_data, num_features] matrix
+    (reference call stack: DatasetLoader::ConstructFromSampleData,
+    src/io/dataset_loader.cpp:653-707 sampling + binning, then row push).
+
+    With `reference` given, reuses its bin mappers so validation data aligns
+    bin-for-bin with the training set (reference: Dataset::CreateValid,
+    dataset.h:721).
+    """
+    data = np.asarray(data)
+    if data.ndim != 2:
+        log_fatal("Training data must be 2-dimensional")
+    num_data, num_cols = data.shape
+    ds = BinnedDataset()
+    ds.num_data = num_data
+    ds.num_total_features = num_cols
+    ds.max_bin = config.max_bin
+
+    if feature_names is None:
+        feature_names = [f"Column_{i}" for i in range(num_cols)]
+    ds.feature_names = list(feature_names)
+
+    cat_set = set(int(c) for c in categorical_feature)
+
+    if reference is not None:
+        ds.mappers = reference.mappers
+        ds.real_feature_index = reference.real_feature_index
+        ds.used_feature_map = reference.used_feature_map
+        ds.reference = reference
+    else:
+        # --- sample rows for binning (loader samples
+        #     bin_construct_sample_cnt rows, dataset_loader.cpp:1162)
+        sample_cnt = min(config.bin_construct_sample_cnt, num_data)
+        rng = np.random.RandomState(config.data_random_seed)
+        if sample_cnt < num_data:
+            sample_idx = np.sort(rng.choice(num_data, sample_cnt, replace=False))
+            sample = data[sample_idx]
+        else:
+            sample = data
+        sample = np.asarray(sample, dtype=np.float64)
+
+        max_bins = list(config.max_bin_by_feature) if config.max_bin_by_feature \
+            else [config.max_bin] * num_cols
+        ds.mappers = []
+        ds.real_feature_index = []
+        ds.used_feature_map = []
+        for j in range(num_cols):
+            bin_type = BIN_TYPE_CATEGORICAL if j in cat_set else BIN_TYPE_NUMERICAL
+            m = BinMapper.find_bin(
+                sample[:, j], total_sample_cnt=len(sample),
+                max_bin=max_bins[j],
+                min_data_in_bin=config.min_data_in_bin,
+                min_split_data=config.min_data_in_leaf,
+                pre_filter=config.feature_pre_filter,
+                bin_type=bin_type,
+                use_missing=config.use_missing,
+                zero_as_missing=config.zero_as_missing)
+            if m.is_trivial:
+                ds.used_feature_map.append(-1)
+            else:
+                ds.used_feature_map.append(len(ds.mappers))
+                ds.mappers.append(m)
+                ds.real_feature_index.append(j)
+        if not ds.mappers:
+            log_warning("There are no meaningful features which satisfy the "
+                        "provided configuration. Decrease min_data_in_bin or "
+                        "check the data.")
+
+    # --- push rows: vectorized value->bin per feature
+    n_feat = len(ds.mappers)
+    max_num_bin = max((m.num_bin for m in ds.mappers), default=2)
+    dtype = np.uint8 if max_num_bin <= 256 else np.uint16
+    X = np.zeros((num_data, max(n_feat, 1)), dtype=dtype)
+    for inner, (m, orig) in enumerate(zip(ds.mappers, ds.real_feature_index)):
+        col = np.asarray(data[:, orig], dtype=np.float64)
+        X[:, inner] = m.value_to_bin(col).astype(dtype)
+    ds.X_binned = X
+
+    md = Metadata(num_data)
+    md.set_label(label)
+    md.set_weight(weight)
+    md.set_group(group)
+    md.set_init_score(init_score)
+    ds.metadata = md
+    return ds

@@ -1,0 +1,275 @@
+"""Training entry points: train() and cv().
+
+API mirrors python-package/lightgbm/engine.py (train:109 with the callback
+loop at :309-332, cv:626, CVBooster:356).
+"""
+
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .callback import (CallbackEnv, EarlyStopException, early_stopping,
+                       log_evaluation)
+from .config import resolve_params
+from .utils.log import log_info, log_warning
+
+
+def train(
+    params: Dict[str, Any],
+    train_set: Dataset,
+    num_boost_round: int = 100,
+    valid_sets: Optional[List[Dataset]] = None,
+    valid_names: Optional[List[str]] = None,
+    feval: Optional[Callable] = None,
+    init_model: Optional[Union[str, Booster]] = None,
+    keep_training_booster: bool = False,
+    callbacks: Optional[List[Callable]] = None,
+    fobj: Optional[Callable] = None,
+) -> Booster:
+    """Train a gradient-boosted model (reference: engine.py:109)."""
+    params = copy.deepcopy(params)
+    cfg = resolve_params(params)
+    if cfg.num_iterations != 100 and num_boost_round == 100:
+        num_boost_round = cfg.num_iterations
+    if cfg.objective in ("none", "custom") and fobj is None:
+        log_warning("Using custom objective requires fobj")
+
+    booster = Booster(params=params, train_set=train_set)
+    if init_model is not None:
+        log_warning("init_model continued training is not yet wired into "
+                    "train(); starting fresh")
+
+    valid_sets = valid_sets or []
+    valid_names = valid_names or []
+    valid_contain_train = False
+    train_data_name = "training"
+    for i, vs in enumerate(valid_sets):
+        name = valid_names[i] if i < len(valid_names) else f"valid_{i}"
+        if vs is train_set:
+            valid_contain_train = True
+            train_data_name = name
+            continue
+        booster.add_valid(vs, name)
+
+    callbacks = list(callbacks) if callbacks else []
+    if cfg.early_stopping_round and cfg.early_stopping_round > 0:
+        callbacks.append(early_stopping(
+            cfg.early_stopping_round, cfg.first_metric_only,
+            verbose=cfg.verbosity >= 1,
+            min_delta=cfg.early_stopping_min_delta))
+    if cfg.verbosity >= 1 and cfg.metric_freq > 0 and not any(
+            getattr(cb, "order", None) == 10 and
+            not getattr(cb, "before_iteration", False) for cb in callbacks):
+        pass  # logging only when user requests via callbacks (sklearn parity)
+    callbacks_before = [cb for cb in callbacks
+                        if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in callbacks
+                       if not getattr(cb, "before_iteration", False)]
+    callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    for it in range(num_boost_round):
+        for cb in callbacks_before:
+            cb(CallbackEnv(model=booster, params=params, iteration=it,
+                           begin_iteration=0, end_iteration=num_boost_round,
+                           evaluation_result_list=None))
+        finished = booster.update(fobj=fobj)
+
+        evaluation_result_list = []
+        if valid_contain_train:
+            evaluation_result_list.extend(
+                [(train_data_name, m, v, h)
+                 for _, m, v, h in booster.eval_train(feval)])
+        if booster.name_valid_sets:
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in callbacks_after:
+                cb(CallbackEnv(model=booster, params=params, iteration=it,
+                               begin_iteration=0,
+                               end_iteration=num_boost_round,
+                               evaluation_result_list=evaluation_result_list))
+        except EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            for ds, metric, value, _ in e.best_score:
+                booster.best_score.setdefault(ds, {})[metric] = value
+            break
+        if finished:
+            break
+    if booster.best_iteration <= 0:
+        booster.best_iteration = booster.current_iteration
+    return booster
+
+
+class CVBooster:
+    """Ensemble of per-fold boosters (reference: engine.py:356)."""
+
+    def __init__(self) -> None:
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name: str):
+        def handler_function(*args: Any, **kwargs: Any) -> List[Any]:
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, nfold: int, params: Dict[str, Any],
+                  stratified: bool, shuffle: bool, seed: int):
+    full_data.construct()
+    num_data = full_data.num_data()
+    label = full_data.get_label()
+    group = full_data.get_group()
+    rng = np.random.RandomState(seed)
+
+    if group is not None:
+        # group-aware folds: split whole queries
+        ngroups = len(group)
+        gidx = np.arange(ngroups)
+        if shuffle:
+            rng.shuffle(gidx)
+        folds_groups = np.array_split(gidx, nfold)
+        boundaries = np.concatenate([[0], np.cumsum(group)])
+        for fg in folds_groups:
+            test_rows = np.concatenate(
+                [np.arange(boundaries[g], boundaries[g + 1]) for g in fg]) \
+                if len(fg) else np.array([], dtype=np.int64)
+            mask = np.zeros(num_data, dtype=bool)
+            mask[test_rows.astype(np.int64)] = True
+            yield np.flatnonzero(~mask), np.flatnonzero(mask), fg
+        return
+
+    idx = np.arange(num_data)
+    if stratified and label is not None:
+        order = np.argsort(label, kind="stable")
+        folds = [order[i::nfold] for i in range(nfold)]
+    else:
+        if shuffle:
+            rng.shuffle(idx)
+        folds = np.array_split(idx, nfold)
+    for f in folds:
+        mask = np.zeros(num_data, dtype=bool)
+        mask[f] = True
+        yield np.flatnonzero(~mask), np.flatnonzero(mask), None
+
+
+def cv(params: Dict[str, Any], train_set: Dataset,
+       num_boost_round: int = 100, folds=None, nfold: int = 5,
+       stratified: bool = True, shuffle: bool = True,
+       metrics: Optional[Union[str, List[str]]] = None,
+       feval: Optional[Callable] = None, init_model=None,
+       fpreproc: Optional[Callable] = None, seed: int = 0,
+       callbacks: Optional[List[Callable]] = None,
+       eval_train_metric: bool = False,
+       return_cvbooster: bool = False) -> Dict[str, Any]:
+    """Cross-validation (reference: engine.py:626)."""
+    params = copy.deepcopy(params)
+    if metrics is not None:
+        params["metric"] = metrics
+    cfg = resolve_params(params)
+    if cfg.num_iterations != 100 and num_boost_round == 100:
+        num_boost_round = cfg.num_iterations
+    if cfg.objective not in ("binary", "multiclass", "multiclassova"):
+        stratified = False
+
+    train_set.construct()
+    full_X = None
+    # cv re-bins each fold from raw rows; requires raw data retained
+    raw = train_set.data
+    if raw is None:
+        raise ValueError("cv() needs the Dataset constructed with "
+                         "free_raw_data=False")
+    from .basic import _to_2d_numpy
+    full_X = _to_2d_numpy(raw)
+    label = train_set.get_label()
+    weight = train_set.get_weight()
+    group = train_set.get_group()
+
+    if folds is None:
+        folds = _make_n_folds(train_set, nfold, params, stratified, shuffle,
+                              seed)
+
+    cvbooster = CVBooster()
+    fold_data = []
+    for train_idx, test_idx, fold_groups in folds:
+        tr_kwargs: Dict[str, Any] = {}
+        va_kwargs: Dict[str, Any] = {}
+        if group is not None:
+            boundaries = np.concatenate([[0], np.cumsum(group)])
+            row2q = np.repeat(np.arange(len(group)), group.astype(np.int64))
+            trq = row2q[train_idx]
+            vaq = row2q[test_idx]
+            tr_kwargs["group"] = np.bincount(
+                trq, minlength=len(group))[np.unique(trq)]
+            va_kwargs["group"] = np.bincount(
+                vaq, minlength=len(group))[np.unique(vaq)]
+        dtrain = Dataset(full_X[train_idx],
+                         label=None if label is None else label[train_idx],
+                         weight=None if weight is None else weight[train_idx],
+                         params=train_set.params, free_raw_data=False,
+                         **tr_kwargs)
+        dvalid = dtrain.create_valid(
+            full_X[test_idx],
+            label=None if label is None else label[test_idx],
+            weight=None if weight is None else weight[test_idx],
+            **va_kwargs)
+        fold_data.append((dtrain, dvalid))
+
+    results = collections.defaultdict(list)
+    boosters = []
+    for dtrain, dvalid in fold_data:
+        bst = Booster(params=params, train_set=dtrain)
+        bst.add_valid(dvalid, "valid")
+        boosters.append(bst)
+        cvbooster.append(bst)
+
+    callbacks = list(callbacks) if callbacks else []
+    es_cb = None
+    if cfg.early_stopping_round and cfg.early_stopping_round > 0:
+        es_cb = early_stopping(cfg.early_stopping_round,
+                               cfg.first_metric_only, verbose=False)
+
+    for it in range(num_boost_round):
+        agg: Dict[str, List[float]] = collections.defaultdict(list)
+        for bst in boosters:
+            bst.update()
+            for ds, m, v, h in bst.eval_valid(feval):
+                agg[f"valid {m}"].append((v, h))
+            if eval_train_metric:
+                for ds, m, v, h in bst.eval_train(feval):
+                    agg[f"train {m}"].append((v, h))
+        merged = []
+        for key, vals in agg.items():
+            vs = [v for v, _ in vals]
+            hib = vals[0][1]
+            results[f"{key}-mean"].append(float(np.mean(vs)))
+            results[f"{key}-stdv"].append(float(np.std(vs)))
+            merged.append(("cv_agg", key, float(np.mean(vs)), hib))
+        try:
+            for cb in callbacks:
+                cb(CallbackEnv(model=cvbooster, params=params, iteration=it,
+                               begin_iteration=0,
+                               end_iteration=num_boost_round,
+                               evaluation_result_list=merged))
+            if es_cb is not None:
+                es_cb(CallbackEnv(model=cvbooster, params=params,
+                                  iteration=it, begin_iteration=0,
+                                  end_iteration=num_boost_round,
+                                  evaluation_result_list=merged))
+        except EarlyStopException as e:
+            cvbooster.best_iteration = e.best_iteration + 1
+            for k in list(results.keys()):
+                results[k] = results[k][:cvbooster.best_iteration]
+            break
+
+    out = dict(results)
+    if return_cvbooster:
+        out["cvbooster"] = cvbooster
+    return out

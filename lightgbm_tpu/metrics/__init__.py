@@ -1,0 +1,408 @@
+"""Evaluation metrics.
+
+Host-side numpy analogs of src/metric/* (factory: src/metric/metric.cpp:88).
+Each metric returns (name, value, is_higher_better). Scores arrive as raw
+model output; metrics apply the objective's output transform themselves the
+way the reference metrics take the ObjectiveFunction's ConvertOutput.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..utils.log import log_fatal, log_warning
+
+_KEPS = 1e-15
+
+MetricResult = Tuple[str, float, bool]  # (name, value, is_higher_better)
+
+
+class Metric:
+    name: str = ""
+    is_higher_better: bool = False
+
+    def __init__(self, config: Config):
+        self.config = config
+
+    def init(self, metadata, num_data: int) -> None:
+        self.label = metadata.label
+        self.weight = metadata.weight
+        self.query_boundaries = metadata.query_boundaries
+        self.num_data = num_data
+        if self.weight is None:
+            self.sum_weights = float(num_data)
+        else:
+            self.sum_weights = float(np.sum(self.weight))
+
+    def eval(self, score: np.ndarray, objective) -> List[MetricResult]:
+        raise NotImplementedError
+
+    def _w(self) -> np.ndarray:
+        if self.weight is not None:
+            return self.weight.astype(np.float64)
+        return np.ones(self.num_data, dtype=np.float64)
+
+
+class _PointwiseRegressionMetric(Metric):
+    """reference: regression_metric.hpp RegressionMetric<T>."""
+
+    transform_output = True
+
+    def point_loss(self, label: np.ndarray, score: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def final_transform(self, mean_loss: float) -> float:
+        return mean_loss
+
+    def eval(self, score, objective) -> List[MetricResult]:
+        score = np.asarray(score, np.float64).reshape(-1)
+        if objective is not None and self.transform_output \
+                and objective.need_convert_output:
+            score = objective.convert_output(score)
+        label = self.label.astype(np.float64)
+        w = self._w()
+        loss = float(np.sum(self.point_loss(label, score) * w) / self.sum_weights)
+        return [(self.name, self.final_transform(loss), self.is_higher_better)]
+
+
+class L2Metric(_PointwiseRegressionMetric):
+    name = "l2"
+
+    def point_loss(self, y, s):
+        return (s - y) ** 2
+
+
+class RMSEMetric(L2Metric):
+    name = "rmse"
+
+    def final_transform(self, v):
+        return float(np.sqrt(v))
+
+
+class L1Metric(_PointwiseRegressionMetric):
+    name = "l1"
+
+    def point_loss(self, y, s):
+        return np.abs(s - y)
+
+
+class QuantileMetric(_PointwiseRegressionMetric):
+    name = "quantile"
+
+    def point_loss(self, y, s):
+        a = self.config.alpha
+        d = y - s
+        return np.where(d >= 0, a * d, (a - 1.0) * d)
+
+
+class HuberMetric(_PointwiseRegressionMetric):
+    name = "huber"
+
+    def point_loss(self, y, s):
+        a = self.config.alpha
+        d = np.abs(s - y)
+        return np.where(d <= a, 0.5 * d * d, a * (d - 0.5 * a))
+
+
+class FairMetric(_PointwiseRegressionMetric):
+    name = "fair"
+
+    def point_loss(self, y, s):
+        c = self.config.fair_c
+        x = np.abs(s - y)
+        return c * x - c * c * np.log1p(x / c)
+
+
+class PoissonMetric(_PointwiseRegressionMetric):
+    name = "poisson"
+
+    def point_loss(self, y, s):
+        eps = 1e-10
+        s = np.maximum(s, eps)
+        return s - y * np.log(s)
+
+
+class MAPEMetric(_PointwiseRegressionMetric):
+    name = "mape"
+
+    def point_loss(self, y, s):
+        return np.abs((y - s)) / np.maximum(1.0, np.abs(y))
+
+
+class GammaMetric(_PointwiseRegressionMetric):
+    """Gamma negative log-likelihood with psi=1
+    (reference: regression_metric.hpp GammaMetric): y/s + log(s)."""
+    name = "gamma"
+
+    def point_loss(self, y, s):
+        s = np.maximum(s, 1e-10)
+        return y / s + np.log(s)
+
+
+class GammaDevianceMetric(_PointwiseRegressionMetric):
+    """reference: regression_metric.hpp GammaDevianceMetric:
+    2*(frac - log(frac) - 1), frac = label/score."""
+    name = "gamma_deviance"
+
+    def point_loss(self, y, s):
+        eps = 1e-9
+        frac = np.maximum(y / np.maximum(s, eps), eps)
+        return 2.0 * (frac - np.log(frac) - 1.0)
+
+
+class TweedieMetric(_PointwiseRegressionMetric):
+    name = "tweedie"
+
+    def point_loss(self, y, s):
+        rho = self.config.tweedie_variance_power
+        eps = 1e-10
+        s = np.maximum(s, eps)
+        a = y * np.power(s, 1.0 - rho) / (1.0 - rho)
+        b = np.power(s, 2.0 - rho) / (2.0 - rho)
+        return -a + b
+
+
+class R2Metric(_PointwiseRegressionMetric):
+    name = "r2"
+    is_higher_better = True
+
+    def eval(self, score, objective):
+        score = np.asarray(score, np.float64).reshape(-1)
+        if objective is not None and objective.need_convert_output:
+            score = objective.convert_output(score)
+        y = self.label.astype(np.float64)
+        w = self._w()
+        ybar = np.sum(y * w) / self.sum_weights
+        ss_res = np.sum(w * (y - score) ** 2)
+        ss_tot = np.sum(w * (y - ybar) ** 2)
+        return [(self.name, float(1.0 - ss_res / max(ss_tot, _KEPS)), True)]
+
+
+# ---------------------------------------------------------------------------
+# binary metrics (reference: binary_metric.hpp:116-271)
+# ---------------------------------------------------------------------------
+class BinaryLoglossMetric(Metric):
+    name = "binary_logloss"
+
+    def eval(self, score, objective) -> List[MetricResult]:
+        p = objective.convert_output(np.asarray(score, np.float64).reshape(-1)) \
+            if objective is not None and objective.need_convert_output else \
+            1.0 / (1.0 + np.exp(-np.asarray(score, np.float64).reshape(-1)))
+        y = (self.label > 0).astype(np.float64)
+        p = np.clip(p, _KEPS, 1.0 - _KEPS)
+        loss = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+        w = self._w()
+        return [(self.name, float(np.sum(loss * w) / self.sum_weights), False)]
+
+
+class BinaryErrorMetric(Metric):
+    name = "binary_error"
+
+    def eval(self, score, objective) -> List[MetricResult]:
+        p = objective.convert_output(np.asarray(score, np.float64).reshape(-1)) \
+            if objective is not None and objective.need_convert_output else \
+            np.asarray(score, np.float64).reshape(-1)
+        y = (self.label > 0)
+        pred = p > 0.5
+        w = self._w()
+        err = (pred != y).astype(np.float64)
+        return [(self.name, float(np.sum(err * w) / self.sum_weights), False)]
+
+
+class AUCMetric(Metric):
+    """reference: binary_metric.hpp AUCMetric (weighted rank sum)."""
+    name = "auc"
+    is_higher_better = True
+
+    def eval(self, score, objective) -> List[MetricResult]:
+        s = np.asarray(score, np.float64).reshape(-1)
+        y = (self.label > 0)
+        w = self._w()
+        order = np.argsort(s, kind="mergesort")
+        s_s, y_s, w_s = s[order], y[order], w[order]
+        # tie-aware trapezoid accumulation
+        pos_w = np.sum(w_s * y_s)
+        neg_w = np.sum(w_s * ~y_s)
+        if pos_w <= 0 or neg_w <= 0:
+            return [(self.name, 1.0, True)]
+        # group by unique score
+        _, idx_start = np.unique(s_s, return_index=True)
+        group_pos = np.add.reduceat(w_s * y_s, idx_start)
+        group_neg = np.add.reduceat(w_s * ~y_s, idx_start)
+        cum_neg = np.cumsum(group_neg) - group_neg
+        auc = np.sum(group_pos * (cum_neg + 0.5 * group_neg)) / (pos_w * neg_w)
+        return [(self.name, float(auc), True)]
+
+
+class AveragePrecisionMetric(Metric):
+    name = "average_precision"
+    is_higher_better = True
+
+    def eval(self, score, objective) -> List[MetricResult]:
+        s = np.asarray(score, np.float64).reshape(-1)
+        y = (self.label > 0).astype(np.float64)
+        w = self._w()
+        order = np.argsort(-s, kind="mergesort")
+        y_s, w_s = y[order], w[order]
+        tp = np.cumsum(w_s * y_s)
+        fp = np.cumsum(w_s * (1 - y_s))
+        total_pos = tp[-1]
+        if total_pos <= 0:
+            return [(self.name, 1.0, True)]
+        precision = tp / np.maximum(tp + fp, _KEPS)
+        recall = tp / total_pos
+        d_recall = np.diff(np.concatenate([[0.0], recall]))
+        ap = float(np.sum(precision * d_recall))
+        return [(self.name, ap, True)]
+
+
+# ---------------------------------------------------------------------------
+# multiclass metrics (reference: multiclass_metric.hpp)
+# ---------------------------------------------------------------------------
+class MultiLoglossMetric(Metric):
+    name = "multi_logloss"
+
+    def eval(self, score, objective) -> List[MetricResult]:
+        # score: [K, N] raw
+        s = np.asarray(score, np.float64)
+        p = objective.convert_output(s) if objective is not None \
+            and objective.need_convert_output else s
+        li = self.label.astype(np.int64)
+        pi = np.clip(p[li, np.arange(len(li))], _KEPS, 1.0)
+        w = self._w()
+        loss = float(np.sum(-np.log(pi) * w) / self.sum_weights)
+        return [(self.name, loss, False)]
+
+
+class MultiErrorMetric(Metric):
+    name = "multi_error"
+
+    def eval(self, score, objective) -> List[MetricResult]:
+        s = np.asarray(score, np.float64)
+        li = self.label.astype(np.int64)
+        k = self.config.multi_error_top_k
+        w = self._w()
+        if k <= 1:
+            pred = np.argmax(s, axis=0)
+            err = (pred != li).astype(np.float64)
+        else:
+            # top-k error: 1 if the true class is not among the k largest
+            part = np.argpartition(-s, k - 1, axis=0)[:k]
+            hit = np.any(part == li[None, :], axis=0)
+            err = (~hit).astype(np.float64)
+        name = self.name if k <= 1 else f"multi_error@{k}"
+        return [(name, float(np.sum(err * w) / self.sum_weights), False)]
+
+
+# ---------------------------------------------------------------------------
+# ranking metrics (reference: rank_metric.hpp:20, map_metric.hpp:21)
+# ---------------------------------------------------------------------------
+class NDCGMetric(Metric):
+    name = "ndcg"
+    is_higher_better = True
+
+    def eval(self, score, objective) -> List[MetricResult]:
+        from .rank_utils import eval_ndcg
+        s = np.asarray(score, np.float64).reshape(-1)
+        return eval_ndcg(s, self.label, self.query_boundaries,
+                         self.weight, self.config.eval_at,
+                         self.config.label_gain)
+
+
+class MapMetric(Metric):
+    name = "map"
+    is_higher_better = True
+
+    def eval(self, score, objective) -> List[MetricResult]:
+        from .rank_utils import eval_map
+        s = np.asarray(score, np.float64).reshape(-1)
+        return eval_map(s, self.label, self.query_boundaries,
+                        self.weight, self.config.eval_at)
+
+
+# ---------------------------------------------------------------------------
+# cross-entropy metrics (reference: xentropy_metric.hpp)
+# ---------------------------------------------------------------------------
+class CrossEntropyMetric(Metric):
+    name = "cross_entropy"
+
+    def eval(self, score, objective) -> List[MetricResult]:
+        p = np.asarray(score, np.float64).reshape(-1)
+        if objective is not None and objective.need_convert_output:
+            p = objective.convert_output(p)
+        else:
+            p = 1.0 / (1.0 + np.exp(-p))
+        y = self.label.astype(np.float64)
+        p = np.clip(p, _KEPS, 1.0 - _KEPS)
+        loss = -(y * np.log(p) + (1 - y) * np.log(1 - p))
+        w = self._w()
+        return [(self.name, float(np.sum(loss * w) / self.sum_weights), False)]
+
+
+class KLDivMetric(Metric):
+    name = "kullback_leibler"
+
+    def eval(self, score, objective) -> List[MetricResult]:
+        p = np.asarray(score, np.float64).reshape(-1)
+        if objective is not None and objective.need_convert_output:
+            p = objective.convert_output(p)
+        else:
+            p = 1.0 / (1.0 + np.exp(-p))
+        y = np.clip(self.label.astype(np.float64), _KEPS, 1 - _KEPS)
+        p = np.clip(p, _KEPS, 1.0 - _KEPS)
+        kl = y * np.log(y / p) + (1 - y) * np.log((1 - y) / (1 - p))
+        w = self._w()
+        return [(self.name, float(np.sum(kl * w) / self.sum_weights), False)]
+
+
+_METRIC_REGISTRY = {
+    "l2": L2Metric, "mean_squared_error": L2Metric, "mse": L2Metric,
+    "regression": L2Metric, "regression_l2": L2Metric,
+    "rmse": RMSEMetric, "root_mean_squared_error": RMSEMetric,
+    "l2_root": RMSEMetric,
+    "l1": L1Metric, "mean_absolute_error": L1Metric, "mae": L1Metric,
+    "regression_l1": L1Metric,
+    "quantile": QuantileMetric,
+    "huber": HuberMetric,
+    "fair": FairMetric,
+    "poisson": PoissonMetric,
+    "mape": MAPEMetric, "mean_absolute_percentage_error": MAPEMetric,
+    "gamma": GammaMetric,
+    "gamma_deviance": GammaDevianceMetric,
+    "tweedie": TweedieMetric,
+    "r2": R2Metric,
+    "binary_logloss": BinaryLoglossMetric, "binary": BinaryLoglossMetric,
+    "binary_error": BinaryErrorMetric,
+    "auc": AUCMetric,
+    "average_precision": AveragePrecisionMetric,
+    "multi_logloss": MultiLoglossMetric, "multiclass": MultiLoglossMetric,
+    "softmax": MultiLoglossMetric, "multiclassova": MultiLoglossMetric,
+    "multi_error": MultiErrorMetric,
+    "ndcg": NDCGMetric, "lambdarank": NDCGMetric,
+    "rank_xendcg": NDCGMetric, "xendcg": NDCGMetric,
+    "map": MapMetric, "mean_average_precision": MapMetric,
+    "cross_entropy": CrossEntropyMetric, "xentropy": CrossEntropyMetric,
+    "cross_entropy_lambda": CrossEntropyMetric,
+    "xentlambda": CrossEntropyMetric,
+    "kullback_leibler": KLDivMetric, "kldiv": KLDivMetric,
+}
+
+
+def create_metric(name: str, config: Config) -> Optional[Metric]:
+    """reference: Metric::CreateMetric (src/metric/metric.cpp:88)."""
+    name = name.strip()
+    if name in ("", "none", "null", "custom", "na"):
+        return None
+    if name not in _METRIC_REGISTRY:
+        log_warning(f"Unknown metric {name!r}; ignored")
+        return None
+    return _METRIC_REGISTRY[name](config)
+
+
+def default_metric_for_objective(objective: str) -> str:
+    """When metric is unset, the reference uses the objective's own metric
+    (config.cpp Config::CheckParamConflict)."""
+    return objective.split(" ")[0]

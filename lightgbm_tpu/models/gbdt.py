@@ -1,0 +1,555 @@
+"""GBDT training orchestrator.
+
+TPU-native analog of src/boosting/gbdt.cpp (GBDT::Init:60, TrainOneIter:353,
+Train:246, UpdateScore:502) + model (de)serialization
+(gbdt_model_text.cpp:321 SaveModelToString, LoadModelFromString).
+
+Device/host split: scores, gradients, the binned matrix and tree growth live
+on device; each grown tree's arrays (a few KB) are pulled back per iteration
+to build the host `Tree` used for model export and raw-data prediction —
+mirroring the CUDA design where only tiny split descriptors cross the
+host<->device boundary (SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..data.binning import BIN_TYPE_CATEGORICAL
+from ..data.dataset import BinnedDataset
+from ..metrics import Metric
+from ..objectives import ObjectiveFunction
+from ..ops.grow import DeviceTree, GrowConfig, grow_tree
+from ..ops.predict import predict_leaf_binned
+from ..ops.split import FeatureMeta
+from ..utils.log import log_fatal, log_info, log_warning
+from .tree import Tree, make_decision_type
+
+_KEPS = 1e-15
+MODEL_VERSION = "v4"
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def build_feature_meta(ds: BinnedDataset) -> FeatureMeta:
+    return FeatureMeta(
+        num_bins=jnp.asarray(ds.feature_num_bins()),
+        missing_type=jnp.asarray(ds.feature_missing_types()),
+        default_bin=jnp.asarray(ds.feature_default_bins()),
+        is_categorical=jnp.asarray(ds.feature_is_categorical()),
+    )
+
+
+class GBDT:
+    """Gradient Boosting Decision Trees (reference: src/boosting/gbdt.h:35)."""
+
+    def __init__(self, config: Config, train_set: Optional[BinnedDataset],
+                 objective: Optional[ObjectiveFunction],
+                 training_metrics: Sequence[Metric] = ()):
+        self.config = config
+        self.objective = objective
+        self.train_set = train_set
+        self.training_metrics = list(training_metrics)
+        self.models: List[Tree] = []
+        self.iter = 0
+        self.num_class = config.num_class
+        self.num_tree_per_iteration = (
+            objective.num_model_per_iteration if objective else config.num_class)
+        self.shrinkage_rate = config.learning_rate
+        self.average_output = False   # RF mode overrides
+        self.valid_sets: List[BinnedDataset] = []
+        self.valid_names: List[str] = []
+        self._valid_scores: List[jnp.ndarray] = []
+        self._valid_meta: List[FeatureMeta] = []
+        self._valid_Xt: List[jnp.ndarray] = []
+        self.best_iteration = -1
+        self.loaded_parameter = ""
+        self.max_feature_idx_ = 0
+        self.feature_names_: List[str] = []
+        self.feature_infos_: List[str] = []
+        self.label_idx_ = 0
+
+        if train_set is not None:
+            self._init_train(train_set)
+
+    # ------------------------------------------------------------------
+    def _init_train(self, ds: BinnedDataset) -> None:
+        cfg = self.config
+        self.num_data = ds.num_data
+        self.max_feature_idx_ = ds.num_total_features - 1
+        self.feature_names_ = list(ds.feature_names)
+        self.feature_infos_ = ds.feature_infos()
+        self.mappers = ds.mappers
+        self.real_feature_index = list(ds.real_feature_index)
+
+        max_bin = max((m.num_bin for m in ds.mappers), default=2)
+        self.num_bins_padded = max(_round_up(max_bin, 8), 8)
+        X = ds.X_binned
+        self.X_t = jnp.asarray(np.ascontiguousarray(X.T))   # [F, N]
+        self.meta = build_feature_meta(ds)
+        self.grow_cfg = GrowConfig(
+            num_leaves=cfg.num_leaves,
+            max_depth=cfg.max_depth,
+            min_data_in_leaf=float(cfg.min_data_in_leaf),
+            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
+            lambda_l1=cfg.lambda_l1,
+            lambda_l2=cfg.lambda_l2,
+            max_delta_step=cfg.max_delta_step,
+            min_gain_to_split=cfg.min_gain_to_split,
+            path_smooth=cfg.path_smooth,
+            num_bins_padded=self.num_bins_padded,
+            rows_per_chunk=cfg.tpu_rows_per_block * 8,
+        )
+
+        K = self.num_tree_per_iteration
+        N = self.num_data
+        md = ds.metadata
+        self.label_dev = jnp.asarray(md.label) if md.label is not None else None
+        self.weight_dev = jnp.asarray(md.weight) if md.weight is not None else None
+
+        # initial scores (Metadata::init_score, c.f. score_updater.hpp:27-47)
+        scores = np.zeros((K, N), dtype=np.float32)
+        if md.init_score is not None:
+            init = np.asarray(md.init_score, np.float64).reshape(-1)
+            scores += init.reshape(K, N) if init.size == K * N else init.reshape(1, N)
+            self._has_init_score = True
+        else:
+            self._has_init_score = False
+        self.scores = jnp.asarray(scores)
+
+        if self.objective is not None:
+            self.objective.init(md, N)
+        for m in self.training_metrics:
+            m.init(md, N)
+
+        # sample strategy (bagging / goss), reference: sample_strategy.cpp:16
+        from .sample_strategy import create_sample_strategy
+        self.sample_strategy = create_sample_strategy(cfg, N, md)
+
+        self._build_jit_fns()
+
+    def _build_jit_fns(self) -> None:
+        cfg_static = self.grow_cfg
+        meta = self.meta
+        shrinkage_is_one = self.config.boosting == "rf"
+
+        @jax.jit
+        def train_tree(X_t, grad, hess, in_bag, scores_k, lr, feat_mask):
+            tree, leaf_of_row = grow_tree(
+                X_t, grad, hess, in_bag, meta, cfg_static,
+                feature_mask=feat_mask)
+            leaf_shrunk = tree.leaf_value * lr
+            new_scores = scores_k + leaf_shrunk[leaf_of_row]
+            return tree, leaf_of_row, new_scores
+
+        self._train_tree = train_tree
+
+        @jax.jit
+        def valid_update(split_feature, threshold_bin, default_left,
+                         left_child, right_child, num_leaves, leaf_value,
+                         Xv_t, vmeta_arrs, scores_k, lr):
+            vmeta = FeatureMeta(*vmeta_arrs)
+            leaf = predict_leaf_binned(split_feature, threshold_bin,
+                                       default_left, left_child, right_child,
+                                       num_leaves, Xv_t, vmeta)
+            return scores_k + (leaf_value * lr)[leaf]
+
+        self._valid_update = valid_update
+
+        if self.objective is not None and not self.objective.runs_on_host:
+            obj = self.objective
+
+            @jax.jit
+            def grad_fn(scores, label, weight):
+                if obj.num_model_per_iteration == 1:
+                    g, h = obj.get_gradients(scores[0], label, weight)
+                    return g[None, :], h[None, :]
+                return obj.get_gradients(scores, label, weight)
+
+            self._grad_fn = grad_fn
+        else:
+            self._grad_fn = None
+
+    # ------------------------------------------------------------------
+    def add_valid_dataset(self, ds: BinnedDataset, name: str,
+                          metrics: Sequence[Metric]) -> None:
+        Xv = ds.X_binned
+        self._valid_Xt.append(jnp.asarray(np.ascontiguousarray(Xv.T)))
+        self._valid_meta.append(self.meta)
+        K = self.num_tree_per_iteration
+        scores = np.zeros((K, ds.num_data), dtype=np.float32)
+        if ds.metadata.init_score is not None:
+            init = np.asarray(ds.metadata.init_score, np.float64).reshape(-1)
+            scores += init.reshape(K, -1) if init.size == K * ds.num_data \
+                else init.reshape(1, -1)
+        # replay already-trained model (continued training)
+        if self.models:
+            for it, tree in enumerate(self.models):
+                k = it % self.num_tree_per_iteration
+                leaf = tree.get_leaf_binned(Xv, self)
+                scores[k] += tree.leaf_value[leaf]
+        self._valid_scores.append(jnp.asarray(scores))
+        self.valid_sets.append(ds)
+        self.valid_names.append(name)
+        for m in metrics:
+            m.init(ds.metadata, ds.num_data)
+
+    # ------------------------------------------------------------------
+    def boost(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Compute gradients from current scores (GBDT::Boosting,
+        gbdt.cpp:229)."""
+        if self.objective is None:
+            log_fatal("No objective function provided for boosting")
+        if self.objective.runs_on_host:
+            score_np = np.asarray(jax.device_get(self.scores))
+            g, h = self.objective.get_gradients_numpy(score_np.reshape(-1))
+            K = self.num_tree_per_iteration
+            return (jnp.asarray(g.reshape(K, -1)),
+                    jnp.asarray(h.reshape(K, -1)))
+        return self._grad_fn(self.scores, self.label_dev, self.weight_dev)
+
+    def train_one_iter(self, grad: Optional[np.ndarray] = None,
+                       hess: Optional[np.ndarray] = None) -> bool:
+        """One boosting iteration (GBDT::TrainOneIter, gbdt.cpp:353).
+        Returns True if training should stop (no splits possible)."""
+        K = self.num_tree_per_iteration
+        init_scores = np.zeros(K)
+        if grad is None or hess is None:
+            if self.iter == 0:
+                init_scores = self._boost_from_average()
+            g_dev, h_dev = self.boost()
+        else:
+            grad = np.asarray(grad, np.float32).reshape(K, -1)
+            hess = np.asarray(hess, np.float32).reshape(K, -1)
+            g_dev, h_dev = jnp.asarray(grad), jnp.asarray(hess)
+
+        in_bag = self.sample_strategy.sample(self.iter, g_dev, h_dev)
+
+        lr = jnp.float32(self.shrinkage_rate)
+        feat_mask = self._feature_mask_for_iter()
+        all_empty = True
+        for k in range(K):
+            tree_dev, leaf_of_row, new_scores = self._train_tree(
+                self.X_t, g_dev[k], h_dev[k],
+                in_bag if in_bag.ndim == 1 else in_bag[k],
+                self.scores[k], lr, feat_mask)
+            host = jax.device_get(tree_dev)
+            num_leaves = int(host.num_leaves)
+            if num_leaves > 1:
+                all_empty = False
+            self.scores = self.scores.at[k].set(new_scores)
+            tree = self._device_tree_to_host(host)
+            # valid scores update BEFORE the bias fold: scorers received the
+            # init score separately in _boost_from_average (the reference
+            # updates scores before AddBias, gbdt.cpp:424-428)
+            L = self.grow_cfg.num_leaves
+            leaf_vals = np.zeros(L, dtype=np.float32)
+            leaf_vals[:tree.num_leaves] = tree.leaf_value[:tree.num_leaves]
+            for vi in range(len(self.valid_sets)):
+                self._valid_scores[vi] = self._valid_scores[vi].at[k].set(
+                    self._valid_update(
+                        tree_dev.split_feature, tree_dev.threshold_bin,
+                        tree_dev.default_left, tree_dev.left_child,
+                        tree_dev.right_child, tree_dev.num_leaves,
+                        jnp.asarray(leaf_vals),
+                        self._valid_Xt[vi], tuple(self._valid_meta[vi]),
+                        self._valid_scores[vi][k], jnp.float32(1.0)))
+            # fold the boost-from-average bias into the first tree
+            # (gbdt.cpp:425-427)
+            if self.iter == 0 and abs(init_scores[k]) > _KEPS:
+                tree.add_bias(init_scores[k])
+                tree.shrinkage = 1.0
+            self.models.append(tree)
+
+        self.iter += 1
+        if all_empty:
+            log_warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            return True
+        return False
+
+    def _boost_from_average(self) -> np.ndarray:
+        """gbdt.cpp:328: initial score from the objective's average."""
+        K = self.num_tree_per_iteration
+        init_scores = np.zeros(K)
+        if (self.objective is None or self._has_init_score
+                or not self.config.boost_from_average):
+            return init_scores
+        for k in range(K):
+            init_scores[k] = self.objective.boost_from_score(k)
+            if abs(init_scores[k]) > _KEPS:
+                self.scores = self.scores.at[k].add(
+                    jnp.float32(init_scores[k]))
+                for vi in range(len(self._valid_scores)):
+                    self._valid_scores[vi] = self._valid_scores[vi].at[k].add(
+                        jnp.float32(init_scores[k]))
+                log_info(f"Start training from score {init_scores[k]:.6f}")
+        return init_scores
+
+    def _feature_mask_for_iter(self) -> Optional[jnp.ndarray]:
+        frac = self.config.feature_fraction
+        F = len(self.mappers)
+        if frac >= 1.0:
+            return None
+        used = max(1, int(round(F * frac)))
+        rng = np.random.RandomState(
+            self.config.feature_fraction_seed + self.iter)
+        mask = np.zeros(F, dtype=bool)
+        mask[rng.choice(F, used, replace=False)] = True
+        return jnp.asarray(mask)
+
+    def rollback_one_iter(self) -> None:
+        """gbdt.cpp:463: undo the last iteration."""
+        if self.iter <= 0:
+            return
+        K = self.num_tree_per_iteration
+        for k in range(K):
+            tree = self.models.pop()
+            kk = K - 1 - k
+            # subtract this tree's contribution from the scores
+            leaf = tree.get_leaf_binned(
+                np.asarray(jax.device_get(self.X_t)).T, self)
+            self.scores = self.scores.at[kk].add(
+                -jnp.asarray(tree.leaf_value[leaf], dtype=jnp.float32))
+            for vi, ds in enumerate(self.valid_sets):
+                leaf_v = tree.get_leaf_binned(ds.X_binned, self)
+                self._valid_scores[vi] = self._valid_scores[vi].at[kk].add(
+                    -jnp.asarray(tree.leaf_value[leaf_v], dtype=jnp.float32))
+        self.iter -= 1
+
+    # ------------------------------------------------------------------
+    def _device_tree_to_host(self, host: Any) -> Tree:
+        """Convert pulled DeviceTree arrays into a host Tree with real
+        thresholds and real feature indices."""
+        n = int(host.num_leaves)
+        m = max(n - 1, 0)
+        sf_inner = np.asarray(host.split_feature[:m], np.int32)
+        thr_bin = np.asarray(host.threshold_bin[:m], np.int32)
+        dleft = np.asarray(host.default_left[:m], bool)
+        thr_real = np.zeros(m, dtype=np.float64)
+        dtype_arr = np.zeros(m, dtype=np.int8)
+        for i in range(m):
+            mp = self.mappers[sf_inner[i]]
+            thr_real[i] = mp.bin_to_value(int(thr_bin[i]))
+            dtype_arr[i] = make_decision_type(
+                mp.bin_type == BIN_TYPE_CATEGORICAL, bool(dleft[i]),
+                mp.missing_type)
+        real_feat = np.asarray(
+            [self.real_feature_index[f] for f in sf_inner], np.int32)
+        lr = self.shrinkage_rate
+        t = Tree.from_arrays(
+            num_leaves=n,
+            split_feature=real_feat,
+            threshold_bin=thr_bin,
+            threshold_real=thr_real,
+            decision_type=dtype_arr,
+            left_child=np.asarray(host.left_child[:m], np.int32),
+            right_child=np.asarray(host.right_child[:m], np.int32),
+            split_gain=np.asarray(host.split_gain[:m], np.float32),
+            leaf_value=np.asarray(host.leaf_value[:n], np.float64) * lr,
+            leaf_weight=np.asarray(host.leaf_weight[:n], np.float64),
+            leaf_count=np.asarray(host.leaf_count[:n], np.int64),
+            internal_value=np.asarray(host.internal_value[:m], np.float64) * lr,
+            internal_weight=np.asarray(host.internal_weight[:m], np.float64),
+            internal_count=np.asarray(host.internal_count[:m], np.int64),
+            shrinkage=lr,
+        )
+        t.split_feature_inner = sf_inner  # kept for binned traversal
+        return t
+
+    # ------------------------------------------------------------------
+    # evaluation
+    # ------------------------------------------------------------------
+    def get_eval_result(self, metrics_per_set: Dict[str, Sequence[Metric]]
+                        ) -> List[Tuple[str, str, float, bool]]:
+        """[(dataset_name, metric_name, value, is_higher_better)]"""
+        out = []
+        for name, metrics in metrics_per_set.items():
+            if name == "training":
+                score = np.asarray(jax.device_get(self.scores))
+            else:
+                vi = self.valid_names.index(name)
+                score = np.asarray(jax.device_get(self._valid_scores[vi]))
+            s = score if score.shape[0] > 1 else score[0]
+            for metric in metrics:
+                for mn, val, hib in metric.eval(s, self.objective):
+                    out.append((name, mn, val, hib))
+        return out
+
+    # ------------------------------------------------------------------
+    # prediction (host trees; raw features)
+    # ------------------------------------------------------------------
+    def predict_raw(self, X: np.ndarray, start_iteration: int = 0,
+                    num_iteration: int = -1) -> np.ndarray:
+        X = np.asarray(X, dtype=np.float64)
+        K = self.num_tree_per_iteration
+        total_iters = len(self.models) // K
+        end = total_iters if num_iteration <= 0 else min(
+            total_iters, start_iteration + num_iteration)
+        out = np.zeros((K, X.shape[0]), dtype=np.float64)
+        for it in range(start_iteration, end):
+            for k in range(K):
+                out[k] += self.models[it * K + k].predict(X)
+        if self.average_output and end > start_iteration:
+            out /= (end - start_iteration)
+        return out
+
+    def predict(self, X: np.ndarray, raw_score: bool = False,
+                start_iteration: int = 0, num_iteration: int = -1
+                ) -> np.ndarray:
+        raw = self.predict_raw(X, start_iteration, num_iteration)
+        if not raw_score and self.objective is not None \
+                and self.objective.need_convert_output:
+            raw = self.objective.convert_output(raw)
+        return raw[0] if raw.shape[0] == 1 else raw.T
+
+    def predict_leaf_index(self, X: np.ndarray, start_iteration: int = 0,
+                           num_iteration: int = -1) -> np.ndarray:
+        X = np.asarray(X, dtype=np.float64)
+        K = self.num_tree_per_iteration
+        total_iters = len(self.models) // K
+        end = total_iters if num_iteration <= 0 else min(
+            total_iters, start_iteration + num_iteration)
+        cols = []
+        for it in range(start_iteration, end):
+            for k in range(K):
+                cols.append(self.models[it * K + k].get_leaf_index(X))
+        return np.stack(cols, axis=1) if cols else np.zeros((X.shape[0], 0))
+
+    # ------------------------------------------------------------------
+    # model serialization (gbdt_model_text.cpp)
+    # ------------------------------------------------------------------
+    def save_model_to_string(self, start_iteration: int = 0,
+                             num_iteration: int = -1,
+                             importance_type: int = 0) -> str:
+        K = self.num_tree_per_iteration
+        total_iters = len(self.models) // K if K else 0
+        start_iteration = max(0, min(start_iteration, total_iters))
+        if num_iteration > 0:
+            num_used = min((start_iteration + num_iteration) * K,
+                           len(self.models))
+        else:
+            num_used = len(self.models)
+        start_model = start_iteration * K
+
+        lines = ["tree"]
+        lines.append(f"version={MODEL_VERSION}")
+        lines.append(f"num_class={self.num_class}")
+        lines.append(f"num_tree_per_iteration={K}")
+        lines.append(f"label_index={self.label_idx_}")
+        lines.append(f"max_feature_idx={self.max_feature_idx_}")
+        if self.objective is not None:
+            lines.append(f"objective={self.objective.to_string()}")
+        if self.average_output:
+            lines.append("average_output")
+        lines.append("feature_names=" + " ".join(self.feature_names_))
+        lines.append("feature_infos=" + " ".join(self.feature_infos_))
+
+        tree_strs = []
+        for i in range(start_model, num_used):
+            s = f"Tree={i - start_model}\n" + self.models[i].to_string() + "\n"
+            tree_strs.append(s)
+        lines.append("tree_sizes=" + " ".join(str(len(s)) for s in tree_strs))
+        lines.append("")
+        body = "\n".join(lines) + "\n"
+        body += "".join(tree_strs)
+        body += "end of trees\n"
+
+        imp = self.feature_importance(importance_type, num_iteration)
+        pairs = [(int(v), self.feature_names_[i]) for i, v in enumerate(imp)
+                 if v > 0]
+        pairs.sort(key=lambda p: -p[0])
+        body += "\nfeature_importances:\n"
+        for v, name in pairs:
+            body += f"{name}={v}\n"
+        body += "\nparameters:\n" + (self.loaded_parameter
+                                     or self.config.to_string()) + "\n"
+        body += "end of parameters\n"
+        return body
+
+    def feature_importance(self, importance_type: int = 0,
+                           num_iteration: int = -1) -> np.ndarray:
+        """reference: GBDT::FeatureImportance (gbdt.cpp)."""
+        K = self.num_tree_per_iteration
+        end = len(self.models) if num_iteration <= 0 else min(
+            len(self.models), num_iteration * K)
+        imp = np.zeros(self.max_feature_idx_ + 1, dtype=np.float64)
+        for tree in self.models[:end]:
+            m = tree.num_leaves - 1
+            for i in range(m):
+                if tree.split_gain[i] > 0:
+                    if importance_type == 0:
+                        imp[tree.split_feature[i]] += 1.0
+                    else:
+                        imp[tree.split_feature[i]] += tree.split_gain[i]
+        return imp
+
+    @classmethod
+    def load_model_from_string(cls, model_str: str,
+                               config: Optional[Config] = None) -> "GBDT":
+        """reference: GBDT::LoadModelFromString (gbdt_model_text.cpp:590)."""
+        from ..config import resolve_params
+        config = config or Config()
+        gbdt = cls(config, None, None)
+        lines = model_str.split("\n")
+        header: Dict[str, str] = {}
+        i = 0
+        while i < len(lines):
+            line = lines[i].strip()
+            if line.startswith("Tree="):
+                break
+            if "=" in line:
+                k, v = line.split("=", 1)
+                header[k] = v
+            elif line == "average_output":
+                gbdt.average_output = True
+            i += 1
+        gbdt.num_class = int(header.get("num_class", "1"))
+        gbdt.num_tree_per_iteration = int(
+            header.get("num_tree_per_iteration", "1"))
+        gbdt.label_idx_ = int(header.get("label_index", "0"))
+        gbdt.max_feature_idx_ = int(header.get("max_feature_idx", "0"))
+        gbdt.feature_names_ = header.get("feature_names", "").split()
+        gbdt.feature_infos_ = header.get("feature_infos", "").split()
+        if "objective" in header:
+            obj_str = header["objective"]
+            cfg2 = _config_from_objective_string(obj_str, config)
+            from ..objectives import create_objective
+            gbdt.objective = create_objective(cfg2)
+            gbdt.config = cfg2
+            gbdt.num_tree_per_iteration = max(
+                gbdt.num_tree_per_iteration,
+                gbdt.objective.num_model_per_iteration
+                if gbdt.objective else 1)
+        # parse trees
+        blocks = model_str.split("Tree=")
+        for blk in blocks[1:]:
+            body = blk.split("\n\n")[0]
+            if "end of trees" in body:
+                body = body.split("end of trees")[0]
+            gbdt.models.append(Tree.from_string(body))
+        gbdt.iter = len(gbdt.models) // max(gbdt.num_tree_per_iteration, 1)
+        return gbdt
+
+
+def _config_from_objective_string(obj_str: str, base: Config) -> Config:
+    """Parse 'binary sigmoid:1' style objective strings from model files."""
+    import dataclasses
+    parts = obj_str.split()
+    cfg = dataclasses.replace(base, objective=parts[0])
+    for tok in parts[1:]:
+        if ":" in tok:
+            k, v = tok.split(":", 1)
+            if k == "num_class":
+                cfg = dataclasses.replace(cfg, num_class=int(v))
+            elif k == "sigmoid":
+                cfg = dataclasses.replace(cfg, sigmoid=float(v))
+    return cfg

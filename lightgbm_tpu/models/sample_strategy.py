@@ -1,0 +1,133 @@
+"""Data sampling strategies: bagging and GOSS.
+
+reference: src/boosting/sample_strategy.cpp:16 (factory),
+bagging.hpp:15 (BaggingSampleStrategy), goss.hpp:19 (GOSSStrategy).
+
+TPU-native formulation: instead of compacting `bag_data_indices_` index lists
+and copying Dataset subrows (CopySubrow, dataset.h:674), sampling produces a
+dense [N] multiplier vector: 0 for out-of-bag rows, 1 for in-bag, and
+(1-top_rate)/other_rate for GOSS-amplified rows. The grower multiplies
+grad/hess by it and carries it as the histogram count channel, which
+reproduces the reference's bagged counts and GOSS-amplified sufficient stats
+with static shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..utils.log import log_info, log_warning
+
+
+class SampleStrategy:
+    is_hessian_change = False
+
+    def __init__(self, config: Config, num_data: int, metadata):
+        self.config = config
+        self.num_data = num_data
+        self.metadata = metadata
+
+    def sample(self, it: int, grad: jnp.ndarray, hess: jnp.ndarray
+               ) -> jnp.ndarray:
+        """Returns the [N] in-bag multiplier for iteration `it`."""
+        return jnp.ones((self.num_data,), jnp.float32)
+
+
+class BaggingSampleStrategy(SampleStrategy):
+    """reference: bagging.hpp:15. Re-samples every `bagging_freq` iterations
+    with fraction `bagging_fraction` (optionally class-stratified via
+    pos/neg_bagging_fraction)."""
+
+    def __init__(self, config: Config, num_data: int, metadata):
+        super().__init__(config, num_data, metadata)
+        self._cached: Optional[jnp.ndarray] = None
+        self._balanced = (config.pos_bagging_fraction < 1.0
+                          or config.neg_bagging_fraction < 1.0)
+        if self._balanced and metadata.label is None:
+            log_warning("pos/neg bagging needs labels; falling back to "
+                        "uniform bagging")
+            self._balanced = False
+
+    def _need_resample(self, it: int) -> bool:
+        freq = max(self.config.bagging_freq, 1)
+        return self._cached is None or it % freq == 0
+
+    def sample(self, it, grad, hess):
+        if not self._need_resample(it):
+            return self._cached
+        rng = np.random.RandomState(self.config.bagging_seed + it)
+        N = self.num_data
+        mask = np.zeros(N, dtype=np.float32)
+        if self._balanced:
+            label = self.metadata.label
+            pos = np.flatnonzero(label > 0)
+            neg = np.flatnonzero(label <= 0)
+            np_pos = int(len(pos) * self.config.pos_bagging_fraction)
+            np_neg = int(len(neg) * self.config.neg_bagging_fraction)
+            mask[rng.choice(pos, np_pos, replace=False)] = 1.0
+            mask[rng.choice(neg, np_neg, replace=False)] = 1.0
+        else:
+            cnt = int(N * self.config.bagging_fraction)
+            mask[rng.choice(N, cnt, replace=False)] = 1.0
+        self._cached = jnp.asarray(mask)
+        return self._cached
+
+
+class GOSSStrategy(SampleStrategy):
+    """Gradient-based One-Side Sampling (reference: goss.hpp:19): keep the
+    top `top_rate` fraction by |grad * hess|, sample `other_rate` of the rest
+    and amplify them by (1 - top_rate) / other_rate."""
+
+    is_hessian_change = True
+
+    def __init__(self, config: Config, num_data: int, metadata):
+        super().__init__(config, num_data, metadata)
+        self.top_k = max(1, int(num_data * config.top_rate))
+        self.other_k = max(1, int(num_data * config.other_rate))
+        # reference warm-up: use all data for 1/learning_rate iterations
+        self.warmup_iters = int(1.0 / config.learning_rate)
+        seed = config.data_random_seed
+        self._key = jax.random.PRNGKey(seed)
+
+    def sample(self, it, grad, hess):
+        if it < self.warmup_iters:
+            return jnp.ones((self.num_data,), jnp.float32)
+        # sum |g*h| over classes (goss.hpp Bagging: sums over tree_id)
+        if grad.ndim == 2:
+            g_abs = jnp.sum(jnp.abs(grad * hess), axis=0)
+        else:
+            g_abs = jnp.abs(grad * hess)
+        N = self.num_data
+        # threshold at the top_k-th largest magnitude
+        topv, _ = jax.lax.top_k(g_abs, self.top_k)
+        threshold = topv[-1]
+        is_top = g_abs >= threshold
+        key = jax.random.fold_in(self._key, it)
+        u = jax.random.uniform(key, (N,))
+        rest = ~is_top
+        # sample `other_k` of the rest uniformly: accept with prob
+        # other_k / (N - top_k)
+        p_accept = self.other_k / max(N - self.top_k, 1)
+        sampled_rest = rest & (u < p_accept)
+        multiplier = (1.0 - self.config.top_rate) / self.config.other_rate
+        return (is_top.astype(jnp.float32)
+                + sampled_rest.astype(jnp.float32) * multiplier)
+
+
+def create_sample_strategy(config: Config, num_data: int,
+                           metadata) -> SampleStrategy:
+    """reference: SampleStrategy::CreateSampleStrategy
+    (sample_strategy.cpp:16)."""
+    if config.data_sample_strategy == "goss":
+        return GOSSStrategy(config, num_data, metadata)
+    if config.bagging_freq > 0 and (
+            config.bagging_fraction < 1.0
+            or config.pos_bagging_fraction < 1.0
+            or config.neg_bagging_fraction < 1.0):
+        return BaggingSampleStrategy(config, num_data, metadata)
+    return SampleStrategy(config, num_data, metadata)

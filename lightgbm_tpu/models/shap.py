@@ -1,0 +1,187 @@
+"""SHAP feature contributions (TreeSHAP).
+
+Analog of the reference's PredictContrib path (Boosting::PredictContrib,
+include/LightGBM/boosting.h:171; tree.cpp TreeSHAP implementation). Standard
+polynomial-time TreeSHAP recursion (Lundberg et al.) over each host Tree,
+using internal/leaf counts as cover weights, exactly as the reference does.
+Output: [N, num_features + 1]; the last column is the expected value.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+
+class _PathElement:
+    __slots__ = ("feature_index", "zero_fraction", "one_fraction", "pweight")
+
+    def __init__(self, feature_index=-1, zero_fraction=0.0, one_fraction=0.0,
+                 pweight=0.0):
+        self.feature_index = feature_index
+        self.zero_fraction = zero_fraction
+        self.one_fraction = one_fraction
+        self.pweight = pweight
+
+
+def _extend_path(path: List[_PathElement], unique_depth: int,
+                 zero_fraction: float, one_fraction: float,
+                 feature_index: int) -> None:
+    path[unique_depth].feature_index = feature_index
+    path[unique_depth].zero_fraction = zero_fraction
+    path[unique_depth].one_fraction = one_fraction
+    path[unique_depth].pweight = 1.0 if unique_depth == 0 else 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        path[i + 1].pweight += one_fraction * path[i].pweight * (i + 1) \
+            / (unique_depth + 1)
+        path[i].pweight = zero_fraction * path[i].pweight \
+            * (unique_depth - i) / (unique_depth + 1)
+
+
+def _unwind_path(path: List[_PathElement], unique_depth: int,
+                 path_index: int) -> None:
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = path[i].pweight
+            path[i].pweight = next_one_portion * (unique_depth + 1) \
+                / ((i + 1) * one_fraction)
+            next_one_portion = tmp - path[i].pweight * zero_fraction \
+                * (unique_depth - i) / (unique_depth + 1)
+        else:
+            path[i].pweight = path[i].pweight * (unique_depth + 1) \
+                / (zero_fraction * (unique_depth - i))
+    for i in range(path_index, unique_depth):
+        path[i].feature_index = path[i + 1].feature_index
+        path[i].zero_fraction = path[i + 1].zero_fraction
+        path[i].one_fraction = path[i + 1].one_fraction
+
+
+def _unwound_path_sum(path: List[_PathElement], unique_depth: int,
+                      path_index: int) -> float:
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    total = 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = next_one_portion * (unique_depth + 1) \
+                / ((i + 1) * one_fraction)
+            total += tmp
+            next_one_portion = path[i].pweight - tmp * zero_fraction \
+                * (unique_depth - i) / (unique_depth + 1)
+        else:
+            total += path[i].pweight / (zero_fraction
+                                        * (unique_depth - i)
+                                        / (unique_depth + 1))
+    return total
+
+
+def _tree_shap(tree, x: np.ndarray, phi: np.ndarray, node: int,
+               unique_depth: int, parent_path: List[_PathElement],
+               parent_zero_fraction: float, parent_one_fraction: float,
+               parent_feature_index: int) -> None:
+    # copy the parent path
+    path = [_PathElement(p.feature_index, p.zero_fraction, p.one_fraction,
+                         p.pweight) for p in parent_path]
+    path += [_PathElement() for _ in range(2)]
+    _extend_path(path, unique_depth, parent_zero_fraction,
+                 parent_one_fraction, parent_feature_index)
+
+    if node < 0:  # leaf
+        leaf = ~node
+        for i in range(1, unique_depth + 1):
+            w = _unwound_path_sum(path, unique_depth, i)
+            el = path[i]
+            phi[el.feature_index] += w * (el.one_fraction - el.zero_fraction) \
+                * tree.leaf_value[leaf]
+        return
+
+    # internal node
+    hot, cold = _decide_children(tree, x, node)
+    w = float(_node_count(tree, node))
+    hot_zero_fraction = _child_count(tree, hot) / w
+    cold_zero_fraction = _child_count(tree, cold) / w
+    incoming_zero_fraction, incoming_one_fraction = 1.0, 1.0
+    split_index = int(tree.split_feature[node])
+
+    # check for a previous split on the same feature
+    path_index = 0
+    while path_index <= unique_depth:
+        if path[path_index].feature_index == split_index:
+            break
+        path_index += 1
+    if path_index != unique_depth + 1:
+        incoming_zero_fraction = path[path_index].zero_fraction
+        incoming_one_fraction = path[path_index].one_fraction
+        _unwind_path(path, unique_depth, path_index)
+        unique_depth -= 1
+
+    _tree_shap(tree, x, phi, hot, unique_depth + 1, path,
+               hot_zero_fraction * incoming_zero_fraction,
+               incoming_one_fraction, split_index)
+    _tree_shap(tree, x, phi, cold, unique_depth + 1, path,
+               cold_zero_fraction * incoming_zero_fraction, 0.0, split_index)
+
+
+def _node_count(tree, node: int) -> float:
+    return max(float(tree.internal_count[node]), 1.0)
+
+
+def _child_count(tree, child: int) -> float:
+    if child < 0:
+        return max(float(tree.leaf_count[~child]), 0.0)
+    return max(float(tree.internal_count[child]), 0.0)
+
+
+def _decide_children(tree, x: np.ndarray, node: int):
+    """(hot, cold) children for row x at node."""
+    single = tree.get_leaf_index  # reuse decision logic via a 1-row call
+    # decide via the same rules as Tree.predict
+    from .tree import _CATEGORICAL_MASK, _DEFAULT_LEFT_MASK
+    dt = int(tree.decision_type[node])
+    fval = x[int(tree.split_feature[node])]
+    default_left = bool(dt & _DEFAULT_LEFT_MASK)
+    mt = (dt >> 2) & 3
+    if dt & _CATEGORICAL_MASK:
+        go_left = bool(tree._cat_decision(np.array([fval]),
+                                          np.array([node]))[0])
+    else:
+        if np.isnan(fval) and mt != 2:
+            fval = 0.0
+        if (mt == 1 and abs(fval) <= 1e-35) or (mt == 2 and np.isnan(fval)):
+            go_left = default_left
+        else:
+            go_left = fval <= tree.threshold[node]
+    l, r = int(tree.left_child[node]), int(tree.right_child[node])
+    return (l, r) if go_left else (r, l)
+
+
+def predict_contrib(gbdt, X: np.ndarray, start_iteration: int = 0,
+                    num_iteration: int = -1) -> np.ndarray:
+    """[N, (F+1) * K] SHAP values (+ expected value column per class)."""
+    X = np.asarray(X, dtype=np.float64)
+    N = X.shape[0]
+    F = gbdt.max_feature_idx_ + 1
+    K = gbdt.num_tree_per_iteration
+    total_iters = len(gbdt.models) // K
+    end = total_iters if num_iteration <= 0 else min(
+        total_iters, start_iteration + num_iteration)
+    out = np.zeros((N, K, F + 1), dtype=np.float64)
+    for it in range(start_iteration, end):
+        for k in range(K):
+            tree = gbdt.models[it * K + k]
+            out[:, k, F] += tree.expected_value()
+            if tree.num_leaves <= 1:
+                continue
+            for r in range(N):
+                phi = np.zeros(F + 1)
+                _tree_shap(tree, X[r], phi, 0, 0, [], 1.0, 1.0, -1)
+                # correction: TreeSHAP bias handled via expected value
+                out[r, k, :F] += phi[:F]
+    if K == 1:
+        return out[:, 0, :]
+    return out.reshape(N, K * (F + 1))

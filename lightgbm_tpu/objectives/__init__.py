@@ -1,0 +1,551 @@
+"""Objective functions.
+
+TPU-native analogs of src/objective/* (factory:
+src/objective/objective_function.cpp:81-141). Gradients/hessians are pure
+elementwise jnp functions evaluated on device inside the per-iteration jit
+(the reference's GetGradients hot loop, gbdt.cpp:229-244, and the CUDA
+objective kernels src/objective/cuda/*).
+
+Scores have shape [num_model_per_iteration, N] (class-major like the
+reference's score layout).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..utils.log import log_fatal, log_warning
+
+_KEPS = 1e-15
+
+
+class ObjectiveFunction:
+    """Base interface (reference: include/LightGBM/objective_function.h)."""
+
+    name: str = "custom"
+    num_model_per_iteration: int = 1
+    is_constant_hessian: bool = False
+    need_convert_output: bool = False
+    # objectives that refit leaf outputs after growth (RenewTreeOutput,
+    # objective_function.h:58): l1/huber/quantile/mape
+    need_renew_tree_output: bool = False
+    # host-computed gradients (ranking objectives)
+    runs_on_host: bool = False
+
+    def __init__(self, config: Config):
+        self.config = config
+        self.num_data = 0
+        self.label: Optional[np.ndarray] = None
+        self.weight: Optional[np.ndarray] = None
+
+    def init(self, metadata, num_data: int) -> None:
+        self.num_data = num_data
+        self.label = metadata.label
+        self.weight = metadata.weight
+
+    def get_gradients(self, score: jnp.ndarray, label: jnp.ndarray,
+                      weight: Optional[jnp.ndarray]
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        raise NotImplementedError
+
+    def boost_from_score(self, class_id: int) -> float:
+        return 0.0
+
+    def convert_output(self, score: np.ndarray) -> np.ndarray:
+        return score
+
+    def renew_tree_output_quantile(self) -> Optional[float]:
+        """Percentile (alpha) for leaf-output renewal, or None."""
+        return None
+
+    def to_string(self) -> str:
+        return self.name
+
+    def _w(self) -> Tuple[np.ndarray, float]:
+        if self.weight is not None:
+            return self.weight.astype(np.float64), float(np.sum(self.weight))
+        return np.ones_like(self.label, dtype=np.float64), float(len(self.label))
+
+
+# ---------------------------------------------------------------------------
+# regression family (reference: src/objective/regression_objective.hpp)
+# ---------------------------------------------------------------------------
+class RegressionL2(ObjectiveFunction):
+    """reference: regression_objective.hpp:94 (grad = score - label,
+    hess = 1)."""
+    name = "regression"
+    is_constant_hessian = True
+
+    def get_gradients(self, score, label, weight):
+        grad = score - label
+        hess = jnp.ones_like(score)
+        if weight is not None:
+            grad, hess = grad * weight, hess * weight
+        return grad, hess
+
+    def boost_from_score(self, class_id: int) -> float:
+        if not self.config.boost_from_average or self.label is None:
+            return 0.0
+        w, sumw = self._w()
+        return float(np.sum(self.label * w) / sumw)
+
+
+class RegressionL1(RegressionL2):
+    """reference: regression_objective.hpp:208."""
+    name = "regression_l1"
+    need_renew_tree_output = True
+
+    def get_gradients(self, score, label, weight):
+        diff = score - label
+        grad = jnp.sign(diff)
+        hess = jnp.ones_like(score)
+        if weight is not None:
+            grad, hess = grad * weight, hess * weight
+        return grad, hess
+
+    def boost_from_score(self, class_id: int) -> float:
+        if not self.config.boost_from_average or self.label is None:
+            return 0.0
+        w, _ = self._w()
+        return _weighted_percentile(self.label, w, 0.5)
+
+    def renew_tree_output_quantile(self):
+        return 0.5
+
+
+class RegressionHuber(RegressionL2):
+    """reference: regression_objective.hpp:294."""
+    name = "huber"
+    is_constant_hessian = False
+    need_renew_tree_output = False  # reference huber does not renew
+
+    def get_gradients(self, score, label, weight):
+        a = self.config.alpha
+        diff = score - label
+        grad = jnp.where(jnp.abs(diff) <= a, diff, jnp.sign(diff) * a)
+        hess = jnp.ones_like(score)
+        if weight is not None:
+            grad, hess = grad * weight, hess * weight
+        return grad, hess
+
+
+class RegressionFair(ObjectiveFunction):
+    """reference: regression_objective.hpp:352."""
+    name = "fair"
+
+    def get_gradients(self, score, label, weight):
+        c = self.config.fair_c
+        x = score - label
+        grad = c * x / (jnp.abs(x) + c)
+        hess = c * c / ((jnp.abs(x) + c) ** 2)
+        if weight is not None:
+            grad, hess = grad * weight, hess * weight
+        return grad, hess
+
+
+class RegressionPoisson(ObjectiveFunction):
+    """reference: regression_objective.hpp:399."""
+    name = "poisson"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.label is not None and np.any(self.label < 0):
+            log_fatal("[poisson]: at least one target label is negative")
+
+    def get_gradients(self, score, label, weight):
+        mds = self.config.poisson_max_delta_step
+        grad = jnp.exp(score) - label
+        hess = jnp.exp(score + mds)
+        if weight is not None:
+            grad, hess = grad * weight, hess * weight
+        return grad, hess
+
+    def boost_from_score(self, class_id: int) -> float:
+        if self.label is None:
+            return 0.0
+        w, sumw = self._w()
+        return float(np.log(max(np.sum(self.label * w) / sumw, _KEPS)))
+
+    def convert_output(self, score):
+        return np.exp(score)
+
+
+class RegressionQuantile(RegressionL2):
+    """reference: regression_objective.hpp:482."""
+    name = "quantile"
+    need_renew_tree_output = True
+
+    def get_gradients(self, score, label, weight):
+        a = self.config.alpha
+        grad = jnp.where(score > label, 1.0 - a, -a)
+        hess = jnp.ones_like(score)
+        if weight is not None:
+            grad, hess = grad * weight, hess * weight
+        return grad, hess
+
+    def boost_from_score(self, class_id: int) -> float:
+        if not self.config.boost_from_average or self.label is None:
+            return 0.0
+        w, _ = self._w()
+        return _weighted_percentile(self.label, w, self.config.alpha)
+
+    def renew_tree_output_quantile(self):
+        return self.config.alpha
+
+
+class RegressionMAPE(RegressionL2):
+    """reference: regression_objective.hpp (RegressionMAPELOSS)."""
+    name = "mape"
+    need_renew_tree_output = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        # label_weight = w / max(1, |label|), normalized to sum to num_data
+        w, _ = self._w()
+        lw = w / np.maximum(1.0, np.abs(self.label))
+        self._label_weight = (lw / np.sum(lw) * len(lw)).astype(np.float32)
+
+    def get_gradients(self, score, label, weight):
+        lw = jnp.asarray(self._label_weight)
+        diff = score - label
+        grad = jnp.sign(diff) * lw
+        hess = lw
+        return grad, hess
+
+    def boost_from_score(self, class_id: int) -> float:
+        if not self.config.boost_from_average or self.label is None:
+            return 0.0
+        return _weighted_percentile(
+            self.label, self._label_weight.astype(np.float64), 0.5)
+
+    def renew_tree_output_quantile(self):
+        return 0.5
+
+
+class RegressionGamma(RegressionPoisson):
+    """reference: regression_objective.hpp (RegressionGammaLoss)."""
+    name = "gamma"
+
+    def get_gradients(self, score, label, weight):
+        grad = 1.0 - label * jnp.exp(-score)
+        hess = label * jnp.exp(-score)
+        if weight is not None:
+            grad, hess = grad * weight, hess * weight
+        return grad, hess
+
+
+class RegressionTweedie(RegressionPoisson):
+    """reference: regression_objective.hpp:718."""
+    name = "tweedie"
+
+    def get_gradients(self, score, label, weight):
+        rho = self.config.tweedie_variance_power
+        e1 = jnp.exp((1.0 - rho) * score)
+        e2 = jnp.exp((2.0 - rho) * score)
+        grad = -label * e1 + e2
+        hess = -label * (1.0 - rho) * e1 + (2.0 - rho) * e2
+        if weight is not None:
+            grad, hess = grad * weight, hess * weight
+        return grad, hess
+
+
+# ---------------------------------------------------------------------------
+# binary (reference: src/objective/binary_objective.hpp:22)
+# ---------------------------------------------------------------------------
+class BinaryLogloss(ObjectiveFunction):
+    name = "binary"
+    need_convert_output = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        label = self.label
+        if label is None:
+            return
+        pos = label > 0
+        w, _ = self._w()
+        cnt_pos = float(np.sum(w[pos]))
+        cnt_neg = float(np.sum(w[~pos]))
+        self._pavg = None
+        pos_w, neg_w = 1.0, 1.0
+        if self.config.is_unbalance and cnt_pos > 0 and cnt_neg > 0:
+            if cnt_pos > cnt_neg:
+                neg_w = cnt_pos / cnt_neg
+            else:
+                pos_w = cnt_neg / cnt_pos
+        pos_w *= self.config.scale_pos_weight
+        self._pos_weight = pos_w
+        self._neg_weight = neg_w
+
+    def get_gradients(self, score, label, weight):
+        sig = self.config.sigmoid
+        is_pos = label > 0
+        y = jnp.where(is_pos, 1.0, -1.0)
+        lw = jnp.where(is_pos, self._pos_weight, self._neg_weight)
+        response = -y * sig / (1.0 + jnp.exp(y * sig * score))
+        abs_r = jnp.abs(response)
+        grad = response * lw
+        hess = abs_r * (sig - abs_r) * lw
+        if weight is not None:
+            grad, hess = grad * weight, hess * weight
+        return grad, hess
+
+    def boost_from_score(self, class_id: int) -> float:
+        """reference: binary_objective.hpp:140 (log-odds of the weighted
+        positive rate, divided by sigmoid)."""
+        if self.label is None:
+            return 0.0
+        w, sumw = self._w()
+        suml = float(np.sum((self.label > 0) * w))
+        pavg = min(max(suml / sumw, _KEPS), 1.0 - _KEPS)
+        init = np.log(pavg / (1.0 - pavg)) / self.config.sigmoid
+        if not self.config.boost_from_average:
+            return 0.0
+        return float(init)
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + np.exp(-self.config.sigmoid * score))
+
+    def to_string(self):
+        return f"binary sigmoid:{self.config.sigmoid:g}"
+
+
+# ---------------------------------------------------------------------------
+# multiclass (reference: src/objective/multiclass_objective.hpp:25,187)
+# ---------------------------------------------------------------------------
+class MulticlassSoftmax(ObjectiveFunction):
+    name = "multiclass"
+    need_convert_output = True
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.num_model_per_iteration = config.num_class
+        if config.num_class <= 1:
+            log_fatal("num_class should be > 1 for multiclass objective")
+        self._factor = config.num_class / (config.num_class - 1.0)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        label = self.label
+        li = label.astype(np.int32)
+        if np.any((li < 0) | (li >= self.config.num_class)):
+            log_fatal(f"Label must be in [0, {self.config.num_class})")
+        w, sumw = self._w()
+        probs = np.zeros(self.config.num_class)
+        np.add.at(probs, li, w)
+        self._class_init_probs = probs / sumw
+
+    def get_gradients(self, score, label, weight):
+        # score: [K, N]
+        p = jax.nn.softmax(score, axis=0)
+        K = score.shape[0]
+        y = (label.astype(jnp.int32)[None, :]
+             == jnp.arange(K, dtype=jnp.int32)[:, None])
+        grad = p - y.astype(p.dtype)
+        hess = self._factor * p * (1.0 - p)
+        if weight is not None:
+            grad = grad * weight[None, :]
+            hess = hess * weight[None, :]
+        return grad, hess
+
+    def boost_from_score(self, class_id: int) -> float:
+        """reference: multiclass_objective.hpp:156."""
+        if not self.config.boost_from_average:
+            return 0.0
+        return float(np.log(max(_KEPS, self._class_init_probs[class_id])))
+
+    def convert_output(self, score):
+        # score: [K, N] -> softmax probabilities
+        e = np.exp(score - np.max(score, axis=0, keepdims=True))
+        return e / np.sum(e, axis=0, keepdims=True)
+
+    def to_string(self):
+        return f"multiclass num_class:{self.config.num_class}"
+
+
+class MulticlassOVA(ObjectiveFunction):
+    """One-vs-all: K independent binary objectives
+    (reference: multiclass_objective.hpp:187)."""
+    name = "multiclassova"
+    need_convert_output = True
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.num_model_per_iteration = config.num_class
+        if config.num_class <= 1:
+            log_fatal("num_class should be > 1 for multiclassova objective")
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        self._binaries = []
+        for k in range(self.config.num_class):
+            b = BinaryLogloss(self.config)
+
+            class _Md:
+                pass
+            md = _Md()
+            md.label = (self.label.astype(np.int32) == k).astype(np.float32)
+            md.weight = self.weight
+            b.init(md, num_data)
+            self._binaries.append(b)
+
+    def get_gradients(self, score, label, weight):
+        K = score.shape[0]
+        grads, hesses = [], []
+        for k in range(K):
+            yk = (label.astype(jnp.int32) == k).astype(jnp.float32)
+            g, h = self._binaries[k].get_gradients(score[k], yk, weight)
+            grads.append(g)
+            hesses.append(h)
+        return jnp.stack(grads), jnp.stack(hesses)
+
+    def boost_from_score(self, class_id: int) -> float:
+        return self._binaries[class_id].boost_from_score(0)
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + np.exp(-self.config.sigmoid * score))
+
+    def to_string(self):
+        return (f"multiclassova num_class:{self.config.num_class} "
+                f"sigmoid:{self.config.sigmoid:g}")
+
+
+# ---------------------------------------------------------------------------
+# cross-entropy (reference: src/objective/xentropy_objective.hpp:45,186)
+# ---------------------------------------------------------------------------
+class CrossEntropy(ObjectiveFunction):
+    name = "cross_entropy"
+    need_convert_output = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if self.label is not None and (np.any(self.label < 0)
+                                       or np.any(self.label > 1)):
+            log_fatal("[cross_entropy]: labels must be in [0, 1]")
+
+    def get_gradients(self, score, label, weight):
+        p = 1.0 / (1.0 + jnp.exp(-score))
+        if weight is None:
+            grad = p - label
+            hess = p * (1.0 - p)
+        else:
+            grad = (p - label) * weight
+            hess = p * (1.0 - p) * weight
+        return grad, hess
+
+    def boost_from_score(self, class_id: int) -> float:
+        if self.label is None:
+            return 0.0
+        w, sumw = self._w()
+        p = float(np.sum(self.label * w) / sumw)
+        p = min(max(p, _KEPS), 1.0 - _KEPS)
+        return float(np.log(p / (1.0 - p)))
+
+    def convert_output(self, score):
+        return 1.0 / (1.0 + np.exp(-score))
+
+    def to_string(self):
+        return "cross_entropy"
+
+
+class CrossEntropyLambda(ObjectiveFunction):
+    """reference: xentropy_objective.hpp:186 (alternative parameterization
+    with weights folded in via log1p)."""
+    name = "cross_entropy_lambda"
+    need_convert_output = True
+
+    def get_gradients(self, score, label, weight):
+        # reference formulation (xentropy_objective.hpp:230-260): with
+        # per-row weight w, hu = w*exp(s) / (1 + w*exp(s))
+        w = weight if weight is not None else 1.0
+        epsilon = jnp.exp(score)
+        hu = w * epsilon / (1.0 + w * epsilon)
+        grad = hu * (1.0 + label) - label
+        hess = hu * (1.0 + label) * (1.0 - hu)
+        return grad, hess
+
+    def boost_from_score(self, class_id: int) -> float:
+        """log(expm1(mean label)) — the inverse of the log1p(exp) output link
+        at the label mean (reference: xentropy_objective.hpp:267)."""
+        if self.label is None:
+            return 0.0
+        w, sumw = self._w()
+        p = max(float(np.sum(self.label * w) / sumw), _KEPS)
+        return float(np.log(max(np.expm1(p), _KEPS)))
+
+    def convert_output(self, score):
+        return np.log1p(np.exp(score))
+
+    def to_string(self):
+        return "cross_entropy_lambda"
+
+
+def _weighted_percentile(values: np.ndarray, weights: np.ndarray,
+                         alpha: float) -> float:
+    """reference: regression_objective.hpp PercentileFun /
+    WeightedPercentileFun (:25-70)."""
+    order = np.argsort(values, kind="stable")
+    v = np.asarray(values, np.float64)[order]
+    w = np.asarray(weights, np.float64)[order]
+    cum = np.cumsum(w) - 0.5 * w
+    total = np.sum(w)
+    if total <= 0:
+        return 0.0
+    q = cum / total
+    return float(np.interp(alpha, q, v))
+
+
+_OBJECTIVE_REGISTRY = {
+    "regression": RegressionL2,
+    "regression_l2": RegressionL2,
+    "l2": RegressionL2,
+    "mean_squared_error": RegressionL2,
+    "mse": RegressionL2,
+    "l2_root": RegressionL2,
+    "root_mean_squared_error": RegressionL2,
+    "rmse": RegressionL2,
+    "regression_l1": RegressionL1,
+    "l1": RegressionL1,
+    "mean_absolute_error": RegressionL1,
+    "mae": RegressionL1,
+    "huber": RegressionHuber,
+    "fair": RegressionFair,
+    "poisson": RegressionPoisson,
+    "quantile": RegressionQuantile,
+    "mape": RegressionMAPE,
+    "mean_absolute_percentage_error": RegressionMAPE,
+    "gamma": RegressionGamma,
+    "tweedie": RegressionTweedie,
+    "binary": BinaryLogloss,
+    "multiclass": MulticlassSoftmax,
+    "softmax": MulticlassSoftmax,
+    "multiclassova": MulticlassOVA,
+    "multiclass_ova": MulticlassOVA,
+    "ova": MulticlassOVA,
+    "ovr": MulticlassOVA,
+    "cross_entropy": CrossEntropy,
+    "xentropy": CrossEntropy,
+    "cross_entropy_lambda": CrossEntropyLambda,
+    "xentlambda": CrossEntropyLambda,
+}
+
+
+def create_objective(config: Config) -> Optional[ObjectiveFunction]:
+    """Factory (reference: ObjectiveFunction::CreateObjectiveFunction,
+    src/objective/objective_function.cpp:81)."""
+    name = config.objective.split(" ")[0]
+    if name in ("none", "null", "custom", "na"):
+        return None
+    # rank objectives are registered lazily (objectives/rank.py)
+    if name in ("lambdarank", "rank_xendcg", "xendcg", "xe_ndcg",
+                "xe_ndcg_mart", "xendcg_mart"):
+        from .rank import LambdarankNDCG, RankXENDCG
+        cls = LambdarankNDCG if name == "lambdarank" else RankXENDCG
+        return cls(config)
+    if name not in _OBJECTIVE_REGISTRY:
+        log_fatal(f"Unknown objective type name: {name}")
+    return _OBJECTIVE_REGISTRY[name](config)

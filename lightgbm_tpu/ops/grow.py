@@ -1,0 +1,322 @@
+"""Leaf-wise (best-first) tree growth, fully on device.
+
+TPU-native re-design of SerialTreeLearner::Train
+(src/treelearner/serial_tree_learner.cpp:183-249) and its CUDA counterpart
+CUDASingleGPUTreeLearner::Train (cuda_single_gpu_tree_learner.cpp:170-330):
+the entire tree is grown inside ONE jitted computation — a
+`lax.fori_loop` over `num_leaves - 1` splits with every buffer statically
+sized — so no host synchronization happens per split (the CUDA learner needs
+one readback per split; here even that is removed).
+
+Key structural translation (see SURVEY.md §7 design stance):
+ - DataPartition's per-leaf index lists (data_partition.hpp:22) become a dense
+   `row -> leaf id` vector updated pointwise at each split; histogram masking
+   replaces index gathering (static shapes; no scatter).
+ - The smaller/larger-leaf histogram subtraction trick is replaced in this
+   baseline path by a single fused 6-channel pass that produces BOTH children's
+   histograms at once ((grad, hess, count) x (left, right)); the
+   compact-gather + subtraction fast path lives in ops/grow_fast.py.
+ - Best-split search is the vectorized scan of ops/split.py.
+ - When `dist` is set, per-leaf histograms are `psum`-reduced across the data-
+   parallel mesh axis before split search, which is exactly the reference's
+   data-parallel ReduceScatter+Allgather of histograms
+   (data_parallel_tree_learner.cpp:286-298) riding ICI instead of sockets.
+
+Leaf/node numbering matches Tree::Split (src/io/tree.cpp:60-100): internal
+node s is created by split s; the left child keeps leaf id `p`, the right
+child becomes new leaf id `s+1`; child pointers store `~leaf` for leaves.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.tree import MISSING_NAN, MISSING_ZERO
+from .histogram import build_histogram
+from .split import (NEG_INF, FeatureMeta, SplitHyperParams, SplitResult,
+                    find_best_split)
+
+
+class GrowConfig(NamedTuple):
+    """Static configuration for the grower (hashable; part of the jit key)."""
+    num_leaves: int
+    max_depth: int              # <=0 means unlimited
+    min_data_in_leaf: float
+    min_sum_hessian_in_leaf: float
+    lambda_l1: float
+    lambda_l2: float
+    max_delta_step: float
+    min_gain_to_split: float
+    path_smooth: float
+    num_bins_padded: int        # B: padded bin axis
+    rows_per_chunk: int = 8192
+
+    @property
+    def hp(self) -> SplitHyperParams:
+        return SplitHyperParams(
+            min_data_in_leaf=self.min_data_in_leaf,
+            min_sum_hessian_in_leaf=self.min_sum_hessian_in_leaf,
+            lambda_l1=self.lambda_l1,
+            lambda_l2=self.lambda_l2,
+            max_delta_step=self.max_delta_step,
+            min_gain_to_split=self.min_gain_to_split,
+            path_smooth=self.path_smooth,
+        )
+
+
+class DeviceTree(NamedTuple):
+    """Grown tree, device-resident (analog of CUDATree, cuda_tree.hpp:29)."""
+    num_leaves: jnp.ndarray        # i32 scalar: actual leaves grown
+    split_feature: jnp.ndarray     # [M] i32 (inner feature index)
+    threshold_bin: jnp.ndarray     # [M] i32
+    default_left: jnp.ndarray      # [M] bool
+    split_gain: jnp.ndarray        # [M] f32
+    left_child: jnp.ndarray        # [M] i32 (negative = ~leaf)
+    right_child: jnp.ndarray       # [M] i32
+    internal_value: jnp.ndarray    # [M] f32
+    internal_weight: jnp.ndarray   # [M] f32
+    internal_count: jnp.ndarray    # [M] i32
+    leaf_value: jnp.ndarray        # [L] f32 (pre-shrinkage)
+    leaf_weight: jnp.ndarray       # [L] f32
+    leaf_count: jnp.ndarray        # [L] i32
+    split_parent_leaf: jnp.ndarray  # [M] i32: which leaf each split divided
+
+
+class _LoopState(NamedTuple):
+    tree: DeviceTree
+    leaf_of_row: jnp.ndarray       # [N] i32
+    leaf_parent_node: jnp.ndarray  # [L] i32 (-1 = root)
+    leaf_is_left: jnp.ndarray      # [L] bool
+    leaf_depth: jnp.ndarray        # [L] i32
+    leaf_output: jnp.ndarray       # [L] f32 (current raw outputs)
+    leaf_sum_g: jnp.ndarray        # [L] f32
+    leaf_sum_h: jnp.ndarray        # [L] f32
+    best: SplitResult              # cached best split per leaf, [L] fields
+    done: jnp.ndarray              # bool scalar
+
+
+def _empty_split_cache(L: int) -> SplitResult:
+    z = jnp.zeros((L,), jnp.float32)
+    return SplitResult(
+        gain=jnp.full((L,), NEG_INF, jnp.float32),
+        feature=jnp.zeros((L,), jnp.int32),
+        threshold=jnp.zeros((L,), jnp.int32),
+        default_left=jnp.zeros((L,), bool),
+        left_sum_g=z, left_sum_h=z, left_count=z,
+        right_sum_g=z, right_sum_h=z, right_count=z,
+        left_output=z, right_output=z,
+    )
+
+
+def _set_cache(cache: SplitResult, idx, res: SplitResult,
+               valid) -> SplitResult:
+    return SplitResult(*[
+        c.at[idx].set(jnp.where(valid, r, c[idx]))
+        for c, r in zip(cache, res)])
+
+
+def grow_tree(
+    X_t: jnp.ndarray,            # [F, N] binned, feature-major
+    grad: jnp.ndarray,           # [N] f32
+    hess: jnp.ndarray,           # [N] f32
+    in_bag: jnp.ndarray,         # [N] f32 (0/1 bagging mask; GOSS weights)
+    meta: FeatureMeta,
+    cfg: GrowConfig,
+    feature_mask: Optional[jnp.ndarray] = None,  # [F] bool per-tree sampling
+    dist: Optional[object] = None,  # parallel.DistContext for data-parallel
+) -> tuple[DeviceTree, jnp.ndarray]:
+    """Grow one tree; returns (DeviceTree, leaf_of_row).
+
+    With `dist`, histograms and root stats are psum-reduced over the mesh data
+    axis, making every device grow the IDENTICAL tree on its row shard —
+    the invariant of the reference's data-parallel learner (SURVEY.md §3.4).
+    """
+    F, N = X_t.shape
+    L = cfg.num_leaves
+    M = max(L - 1, 1)
+    B = cfg.num_bins_padded
+    hp = cfg.hp
+    max_depth = cfg.max_depth if cfg.max_depth > 0 else 10**9
+
+    def psum(x):
+        return dist.psum(x) if dist is not None else x
+
+    g = grad.astype(jnp.float32) * in_bag
+    h = hess.astype(jnp.float32) * in_bag
+
+    def hist_for_children(leaf_l, leaf_r, leaf_of_row):
+        """One fused pass: histograms for both children ((g,h,c) x (l,r))."""
+        in_l = (leaf_of_row == leaf_l).astype(jnp.float32) * in_bag
+        in_r = (leaf_of_row == leaf_r).astype(jnp.float32) * in_bag
+        vals = jnp.stack([g * in_l, h * in_l, in_l,
+                          g * in_r, h * in_r, in_r], axis=1)  # [N, 6]
+        hist6 = build_histogram(X_t, vals, B, cfg.rows_per_chunk)
+        hist6 = psum(hist6)
+        return hist6[..., :3], hist6[..., 3:]
+
+    # ---- root (BeforeTrain: serial_tree_learner.cpp:292-342)
+    root_g = psum(jnp.sum(g))
+    root_h = psum(jnp.sum(h))
+    root_c = psum(jnp.sum(in_bag))
+    root_out = jnp.asarray(
+        -jnp.sign(root_g) * jnp.maximum(jnp.abs(root_g) - hp.lambda_l1, 0.0)
+        / (root_h + hp.lambda_l2), jnp.float32)
+
+    in_root = in_bag
+    vals0 = jnp.stack([g, h, in_root], axis=1)
+    hist_root = psum(build_histogram(X_t, vals0, B, cfg.rows_per_chunk))
+    root_split = find_best_split(hist_root, root_g, root_h, root_c, root_out,
+                                 meta, hp, feature_mask)
+    root_split = root_split._replace(
+        gain=jnp.where(max_depth >= 1, root_split.gain, NEG_INF))
+
+    tree = DeviceTree(
+        num_leaves=jnp.asarray(1, jnp.int32),
+        split_feature=jnp.zeros((M,), jnp.int32),
+        threshold_bin=jnp.zeros((M,), jnp.int32),
+        default_left=jnp.zeros((M,), bool),
+        split_gain=jnp.zeros((M,), jnp.float32),
+        left_child=jnp.zeros((M,), jnp.int32),
+        right_child=jnp.zeros((M,), jnp.int32),
+        internal_value=jnp.zeros((M,), jnp.float32),
+        internal_weight=jnp.zeros((M,), jnp.float32),
+        internal_count=jnp.zeros((M,), jnp.int32),
+        leaf_value=jnp.zeros((L,), jnp.float32).at[0].set(root_out),
+        leaf_weight=jnp.zeros((L,), jnp.float32).at[0].set(root_h),
+        leaf_count=jnp.zeros((L,), jnp.int32).at[0].set(
+            root_c.astype(jnp.int32)),
+        split_parent_leaf=jnp.zeros((M,), jnp.int32),
+    )
+    cache = _set_cache(_empty_split_cache(L), 0, root_split, True)
+    state = _LoopState(
+        tree=tree,
+        leaf_of_row=jnp.zeros((N,), jnp.int32),
+        leaf_parent_node=jnp.full((L,), -1, jnp.int32),
+        leaf_is_left=jnp.zeros((L,), bool),
+        leaf_depth=jnp.zeros((L,), jnp.int32),
+        leaf_output=jnp.zeros((L,), jnp.float32).at[0].set(root_out),
+        leaf_sum_g=jnp.zeros((L,), jnp.float32).at[0].set(root_g),
+        leaf_sum_h=jnp.zeros((L,), jnp.float32).at[0].set(root_h),
+        best=cache,
+        done=jnp.asarray(False),
+    )
+
+    def split_once(s, st: _LoopState) -> _LoopState:
+        """One split (the reference's `for split ...` body,
+        serial_tree_learner.cpp:222-240)."""
+        t = st.tree
+        p = jnp.argmax(st.best.gain).astype(jnp.int32)
+        bs = SplitResult(*[a[p] for a in st.best])
+        valid = (bs.gain > 0.0) & ~st.done
+        new_leaf = (s + 1).astype(jnp.int32)
+
+        # -- record internal node s
+        def rec(arr, v):
+            return arr.at[s].set(jnp.where(valid, v, arr[s]))
+
+        t = t._replace(
+            split_feature=rec(t.split_feature, bs.feature),
+            threshold_bin=rec(t.threshold_bin, bs.threshold),
+            default_left=rec(t.default_left, bs.default_left),
+            split_gain=rec(t.split_gain, bs.gain),
+            left_child=rec(t.left_child, ~p),
+            right_child=rec(t.right_child, ~new_leaf),
+            internal_value=rec(t.internal_value, st.leaf_output[p]),
+            internal_weight=rec(t.internal_weight, st.leaf_sum_h[p]),
+            internal_count=rec(t.internal_count, t.leaf_count[p]),
+            split_parent_leaf=rec(t.split_parent_leaf, p),
+            num_leaves=t.num_leaves + valid.astype(jnp.int32),
+        )
+        # -- fix the pointer that used to reference leaf p
+        prev = st.leaf_parent_node[p]
+        prev_i = jnp.maximum(prev, 0)
+        fix = valid & (prev >= 0)
+        t = t._replace(
+            left_child=t.left_child.at[prev_i].set(
+                jnp.where(fix & st.leaf_is_left[p], s, t.left_child[prev_i])),
+            right_child=t.right_child.at[prev_i].set(
+                jnp.where(fix & ~st.leaf_is_left[p], s,
+                          t.right_child[prev_i])))
+
+        # -- partition update (DataPartition::Split analog,
+        #    data_partition.hpp:102): rows of leaf p re-tagged left/right
+        col = jnp.take(X_t, bs.feature, axis=0).astype(jnp.int32)   # [N]
+        mt = meta.missing_type[bs.feature]
+        is_missing = ((mt == MISSING_ZERO)
+                      & (col == meta.default_bin[bs.feature])) | \
+                     ((mt == MISSING_NAN)
+                      & (col == meta.num_bins[bs.feature] - 1))
+        go_left = jnp.where(is_missing, bs.default_left, col <= bs.threshold)
+        in_p = st.leaf_of_row == p
+        leaf_of_row = jnp.where(valid & in_p & ~go_left, new_leaf,
+                                st.leaf_of_row)
+
+        # -- per-leaf bookkeeping
+        depth_child = st.leaf_depth[p] + 1
+        leaf_parent_node = st.leaf_parent_node.at[p].set(
+            jnp.where(valid, s, st.leaf_parent_node[p]))
+        leaf_parent_node = leaf_parent_node.at[new_leaf].set(
+            jnp.where(valid, s, leaf_parent_node[new_leaf]))
+        leaf_is_left = st.leaf_is_left.at[p].set(
+            jnp.where(valid, True, st.leaf_is_left[p]))
+        leaf_is_left = leaf_is_left.at[new_leaf].set(
+            jnp.where(valid, False, leaf_is_left[new_leaf]))
+        leaf_depth = st.leaf_depth.at[p].set(
+            jnp.where(valid, depth_child, st.leaf_depth[p]))
+        leaf_depth = leaf_depth.at[new_leaf].set(
+            jnp.where(valid, depth_child, leaf_depth[new_leaf]))
+
+        def upd(arr, l_val, r_val, cast=None):
+            lv = l_val if cast is None else l_val.astype(cast)
+            rv = r_val if cast is None else r_val.astype(cast)
+            arr = arr.at[p].set(jnp.where(valid, lv, arr[p]))
+            return arr.at[new_leaf].set(jnp.where(valid, rv, arr[new_leaf]))
+
+        t = t._replace(
+            leaf_value=upd(t.leaf_value, bs.left_output, bs.right_output),
+            leaf_weight=upd(t.leaf_weight, bs.left_sum_h, bs.right_sum_h),
+            leaf_count=upd(t.leaf_count, bs.left_count, bs.right_count,
+                           jnp.int32),
+        )
+        leaf_output = upd(st.leaf_output, bs.left_output, bs.right_output)
+        leaf_sum_g = upd(st.leaf_sum_g, bs.left_sum_g, bs.right_sum_g)
+        leaf_sum_h = upd(st.leaf_sum_h, bs.left_sum_h, bs.right_sum_h)
+
+        # -- histograms + split search for both children
+        def compute_children(_):
+            hist_l, hist_r = hist_for_children(p, new_leaf, leaf_of_row)
+            can_l = depth_child < max_depth
+            can_r = depth_child < max_depth
+            sl = find_best_split(hist_l, bs.left_sum_g, bs.left_sum_h,
+                                 bs.left_count, bs.left_output, meta, hp,
+                                 feature_mask)
+            sr = find_best_split(hist_r, bs.right_sum_g, bs.right_sum_h,
+                                 bs.right_count, bs.right_output, meta, hp,
+                                 feature_mask)
+            sl = sl._replace(gain=jnp.where(can_l, sl.gain, NEG_INF))
+            sr = sr._replace(gain=jnp.where(can_r, sr.gain, NEG_INF))
+            return sl, sr
+
+        def skip_children(_):
+            zero = _empty_split_cache(1)
+            one = SplitResult(*[a[0] for a in zero])
+            return one, one
+
+        sl, sr = jax.lax.cond(valid, compute_children, skip_children, None)
+        best = _set_cache(st.best, p, sl, valid)
+        best = _set_cache(best, new_leaf, sr, valid)
+
+        return _LoopState(
+            tree=t, leaf_of_row=leaf_of_row,
+            leaf_parent_node=leaf_parent_node, leaf_is_left=leaf_is_left,
+            leaf_depth=leaf_depth, leaf_output=leaf_output,
+            leaf_sum_g=leaf_sum_g, leaf_sum_h=leaf_sum_h,
+            best=best, done=st.done | ~valid)
+
+    if L > 1:
+        state = jax.lax.fori_loop(0, L - 1, split_once, state)
+    return state.tree, state.leaf_of_row

@@ -1,0 +1,83 @@
+"""Histogram construction on device.
+
+The reference's hot loop #1 (Bin::ConstructHistogram, src/io/dense_bin.hpp /
+sparse_bin.hpp; CUDA analog cuda_histogram_constructor.cu:20-72) is a
+gather-accumulate: hist[bin[r, f]] += (grad[r], hess[r]).
+
+TPUs have no scatter-add in the VPU/MXU path, so the TPU-native formulation is
+a one-hot contraction on the MXU: for each row-chunk,
+
+    hist[f, b, c] += sum_r  onehot(bin[f, r] == b) * vals[r, c]
+
+which XLA lowers to batched [B, R] @ [R, C] matmuls per feature block. The
+VMEM blocking mirrors the CUDA kernel's shared-memory per-block histogram with
+the flush/atomicAdd replaced by the contraction itself. A fused Pallas variant
+lives in `histogram_pallas.py`; this module is the portable XLA lowering used
+on CPU meshes and as a fallback.
+
+Layout: the binned matrix is feature-major [F, N] so that single-feature
+column reads (partition updates, ops/grow.py) are contiguous slices.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def build_histogram(
+    X_binned_t: jnp.ndarray,   # [F, N] uint8/uint16/int32 (feature-major)
+    vals: jnp.ndarray,         # [N, C] float32 (grad, hess, count, ... masked)
+    num_bins: int,             # B: padded bin-axis size (static)
+    rows_per_chunk: int = 8192,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """Dense one-hot-matmul histogram: returns [F, B, C] float32.
+
+    `vals` must already be masked (zeroed) for rows outside the target leaf /
+    bag. Rows are processed in chunks under `lax.scan` so the materialized
+    one-hot block stays in VMEM-sized pieces.
+    """
+    F, N = X_binned_t.shape
+    C = vals.shape[1]
+    B = num_bins
+    chunk = min(rows_per_chunk, _round_up(N, 128))
+    Np = _round_up(N, chunk)
+    if Np != N:
+        X_binned_t = jnp.pad(X_binned_t, ((0, 0), (0, Np - N)))
+        vals = jnp.pad(vals, ((0, Np - N), (0, 0)))
+    n_chunks = Np // chunk
+
+    Xc = X_binned_t.reshape(F, n_chunks, chunk).transpose(1, 0, 2)  # [nc,F,R]
+    Vc = vals.reshape(n_chunks, chunk, C).astype(dtype)
+    iota = jnp.arange(B, dtype=jnp.int32)
+
+    def body(hist, xs):
+        xb, vb = xs                                   # [F, R], [R, C]
+        onehot = (xb[:, :, None].astype(jnp.int32) == iota[None, None, :]
+                  ).astype(dtype)                     # [F, R, B]
+        part = jnp.einsum("frb,rc->fbc", onehot, vb,
+                          preferred_element_type=jnp.float32)
+        return hist + part, None
+
+    hist0 = jnp.zeros((F, B, C), dtype=jnp.float32)
+    hist, _ = jax.lax.scan(body, hist0, (Xc, Vc))
+    return hist
+
+
+def build_histogram_1d(
+    bins: jnp.ndarray,       # [N] int
+    vals: jnp.ndarray,       # [N, C] float32
+    num_bins: int,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    """[B, C] histogram over a single bin vector (used by categorical and
+    quantile helpers)."""
+    iota = jnp.arange(num_bins, dtype=jnp.int32)
+    onehot = (bins[:, None].astype(jnp.int32) == iota[None, :]).astype(dtype)
+    return jnp.einsum("rb,rc->bc", onehot, vals.astype(dtype),
+                      preferred_element_type=jnp.float32)

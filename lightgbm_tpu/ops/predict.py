@@ -1,0 +1,63 @@
+"""Tree traversal over binned features, on device.
+
+Vectorized analog of Tree::GetLeaf / NumericalDecisionInner
+(include/LightGBM/tree.h:358-440): all rows walk the tree in lockstep under a
+`lax.while_loop`; each step gathers the current node's split feature column
+and advances. Used for validation-score updates during training and for
+device-side prediction on binned data.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..models.tree import MISSING_NAN, MISSING_ZERO
+from .split import FeatureMeta
+
+
+def predict_leaf_binned(
+    split_feature: jnp.ndarray,   # [M] i32
+    threshold_bin: jnp.ndarray,   # [M] i32
+    default_left: jnp.ndarray,    # [M] bool
+    left_child: jnp.ndarray,      # [M] i32 (negative = ~leaf)
+    right_child: jnp.ndarray,     # [M] i32
+    num_leaves: jnp.ndarray,      # i32 scalar
+    X_t: jnp.ndarray,             # [F, N] binned feature-major
+    meta: FeatureMeta,
+) -> jnp.ndarray:
+    """Leaf index per row ([N] int32)."""
+    N = X_t.shape[1]
+    rows = jnp.arange(N, dtype=jnp.int32)
+
+    # node >= 0: internal node to test; node < 0: arrived at leaf ~node
+    node0 = jnp.where(num_leaves > 1,
+                      jnp.zeros((N,), jnp.int32),
+                      jnp.full((N,), -1, jnp.int32))
+
+    def cond(node):
+        return jnp.any(node >= 0)
+
+    def body(node):
+        nd = jnp.maximum(node, 0)
+        f = split_feature[nd]                          # [N]
+        bin_v = X_t[f, rows].astype(jnp.int32)         # [N] gather
+        mt = meta.missing_type[f]
+        is_missing = ((mt == MISSING_ZERO) & (bin_v == meta.default_bin[f])) \
+            | ((mt == MISSING_NAN) & (bin_v == meta.num_bins[f] - 1))
+        go_left = jnp.where(is_missing, default_left[nd],
+                            bin_v <= threshold_bin[nd])
+        nxt = jnp.where(go_left, left_child[nd], right_child[nd])
+        return jnp.where(node >= 0, nxt, node)
+
+    node = jax.lax.while_loop(cond, body, node0)
+    return ~node
+
+
+def add_tree_score(
+    score: jnp.ndarray,           # [N] f32
+    leaf_value: jnp.ndarray,      # [L] f32 (already shrunk)
+    leaf_idx: jnp.ndarray,        # [N] i32
+) -> jnp.ndarray:
+    """ScoreUpdater::AddScore analog (src/boosting/score_updater.hpp:22)."""
+    return score + leaf_value[leaf_idx]

@@ -1,0 +1,115 @@
+import numpy as np
+import pytest
+
+from lightgbm_tpu.data.binning import (BIN_TYPE_CATEGORICAL, BinMapper,
+                                       greedy_find_bin)
+from lightgbm_tpu.models.tree import MISSING_NAN, MISSING_NONE, MISSING_ZERO
+
+
+def test_few_distinct_values_get_own_bins():
+    vals = np.array([1.0, 2.0, 3.0] * 50)
+    m = BinMapper.find_bin(vals, total_sample_cnt=150, max_bin=255,
+                           min_data_in_bin=3, min_split_data=0)
+    assert not m.is_trivial
+    bins = m.value_to_bin(np.array([1.0, 2.0, 3.0]))
+    assert len(set(bins.tolist())) == 3
+    # ordering preserved
+    assert bins[0] < bins[1] < bins[2]
+
+
+def test_continuous_binning_respects_max_bin():
+    rng = np.random.RandomState(0)
+    vals = rng.normal(size=10000)
+    m = BinMapper.find_bin(vals, total_sample_cnt=10000, max_bin=64,
+                           min_data_in_bin=3, min_split_data=0)
+    assert m.num_bin <= 64
+    assert m.num_bin > 32   # should use most of the budget
+    b = m.value_to_bin(vals)
+    assert b.min() >= 0 and b.max() < m.num_bin
+    # bins are monotone in value
+    order = np.argsort(vals)
+    assert np.all(np.diff(b[order]) >= 0)
+
+
+def test_zero_gets_own_bin():
+    rng = np.random.RandomState(1)
+    vals = np.concatenate([np.zeros(5000), rng.uniform(1, 2, 5000)])
+    m = BinMapper.find_bin(vals, total_sample_cnt=10000, max_bin=32,
+                           min_data_in_bin=3, min_split_data=0)
+    zb = m.value_to_bin(np.array([0.0]))[0]
+    nb = m.value_to_bin(np.array([1.5]))[0]
+    assert zb != nb
+    assert m.default_bin == zb
+
+
+def test_nan_missing_type_and_bin():
+    rng = np.random.RandomState(2)
+    vals = rng.normal(size=1000)
+    vals[::10] = np.nan
+    m = BinMapper.find_bin(vals, total_sample_cnt=1000, max_bin=32,
+                           min_data_in_bin=3, min_split_data=0,
+                           use_missing=True)
+    assert m.missing_type == MISSING_NAN
+    b = m.value_to_bin(np.array([np.nan]))
+    assert b[0] == m.num_bin - 1
+
+
+def test_no_missing_gives_none_type():
+    vals = np.random.RandomState(3).normal(size=1000)
+    m = BinMapper.find_bin(vals, total_sample_cnt=1000, max_bin=32,
+                           min_data_in_bin=3, min_split_data=0)
+    assert m.missing_type == MISSING_NONE
+
+
+def test_zero_as_missing():
+    vals = np.concatenate([np.zeros(500),
+                           np.random.RandomState(4).uniform(1, 2, 500)])
+    m = BinMapper.find_bin(vals, total_sample_cnt=1000, max_bin=32,
+                           min_data_in_bin=3, min_split_data=0,
+                           zero_as_missing=True)
+    assert m.missing_type == MISSING_ZERO
+
+
+def test_categorical_mapping_by_frequency():
+    vals = np.array([0.0] * 100 + [1.0] * 50 + [2.0] * 10 + [7.0] * 200)
+    m = BinMapper.find_bin(vals, total_sample_cnt=360, max_bin=32,
+                           min_data_in_bin=1, min_split_data=0,
+                           bin_type=BIN_TYPE_CATEGORICAL)
+    assert m.bin_type == BIN_TYPE_CATEGORICAL
+    # most frequent category (7) gets bin 1 (bin 0 is the NaN/other bin)
+    assert m.categorical_2_bin[7] == 1
+    assert m.categorical_2_bin[0] == 2
+    b = m.value_to_bin(np.array([7.0, 0.0, 1.0, 2.0, 99.0]))
+    assert b[0] == 1 and b[4] == 0  # unseen category -> bin 0
+
+
+def test_trivial_feature():
+    # constant zero: single bin -> trivial
+    m = BinMapper.find_bin(np.zeros(100), total_sample_cnt=100, max_bin=32,
+                           min_data_in_bin=3, min_split_data=0)
+    assert m.is_trivial
+    # constant non-zero: gets a (zero, value) bin pair but pre-filter marks
+    # it trivial because no split can satisfy min_data (reference NeedFilter)
+    m2 = BinMapper.find_bin(np.ones(100) * 3.0, total_sample_cnt=100,
+                            max_bin=32, min_data_in_bin=3, min_split_data=20,
+                            pre_filter=True)
+    assert m2.is_trivial
+
+
+def test_value_to_bin_boundaries():
+    vals = np.array([1.0] * 10 + [2.0] * 10 + [3.0] * 10)
+    m = BinMapper.find_bin(vals, total_sample_cnt=30, max_bin=255,
+                           min_data_in_bin=1, min_split_data=0)
+    # upper bound is midpoint: 1.5, 2.5
+    b1 = m.value_to_bin(np.array([1.49]))[0]
+    b2 = m.value_to_bin(np.array([1.51]))[0]
+    assert b1 != b2
+
+
+def test_mapper_roundtrip_serialization():
+    vals = np.random.RandomState(5).normal(size=500)
+    m = BinMapper.find_bin(vals, total_sample_cnt=500, max_bin=16,
+                           min_data_in_bin=3, min_split_data=0)
+    m2 = BinMapper.from_dict(m.to_dict())
+    test = np.random.RandomState(6).normal(size=100)
+    assert np.array_equal(m.value_to_bin(test), m2.value_to_bin(test))

@@ -1,0 +1,65 @@
+import pytest
+
+from lightgbm_tpu.config import Config, resolve_params
+from lightgbm_tpu.utils.log import FatalError
+
+
+def test_defaults():
+    cfg = Config()
+    assert cfg.num_leaves == 31
+    assert cfg.learning_rate == 0.1
+    assert cfg.max_bin == 255
+    assert cfg.objective == "regression"
+    assert cfg.min_data_in_leaf == 20
+
+
+def test_alias_resolution():
+    cfg = resolve_params({"n_estimators": 50, "eta": 0.3,
+                          "min_child_samples": 5, "reg_lambda": 1.5,
+                          "subsample": 0.8, "colsample_bytree": 0.7})
+    assert cfg.num_iterations == 50
+    assert cfg.learning_rate == 0.3
+    assert cfg.min_data_in_leaf == 5
+    assert cfg.lambda_l2 == 1.5
+    assert cfg.bagging_fraction == 0.8
+    assert cfg.feature_fraction == 0.7
+
+
+def test_string_coercion():
+    cfg = resolve_params({"num_leaves": "63", "lambda_l1": "0.5",
+                          "boost_from_average": "false"})
+    assert cfg.num_leaves == 63
+    assert cfg.lambda_l1 == 0.5
+    assert cfg.boost_from_average is False
+
+
+def test_boosting_normalization():
+    assert resolve_params({"boosting": "gbrt"}).boosting == "gbdt"
+    assert resolve_params({"boosting": "random_forest",
+                           "bagging_freq": 1,
+                           "bagging_fraction": 0.5}).boosting == "rf"
+    cfg = resolve_params({"boosting": "goss"})
+    assert cfg.boosting == "gbdt"
+    assert cfg.data_sample_strategy == "goss"
+
+
+def test_validation_errors():
+    with pytest.raises(FatalError):
+        resolve_params({"num_leaves": 1})
+    with pytest.raises(FatalError):
+        resolve_params({"bagging_fraction": 0.0})
+    with pytest.raises(FatalError):
+        resolve_params({"tree_learner": "bogus"})
+
+
+def test_metric_list():
+    cfg = resolve_params({"metric": "auc,binary_logloss"})
+    assert cfg.metric == ["auc", "binary_logloss"]
+    cfg = resolve_params({"metric": ["l2", "l1"]})
+    assert cfg.metric == ["l2", "l1"]
+
+
+def test_config_to_string_roundtrippable():
+    s = Config().to_string()
+    assert "[num_leaves: 31]" in s
+    assert "[learning_rate: 0.1]" in s

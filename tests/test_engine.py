@@ -1,0 +1,145 @@
+"""End-to-end training tests (the analog of the reference's
+tests/python_package_test/test_engine.py)."""
+
+import numpy as np
+import pytest
+from sklearn.datasets import load_breast_cancer, make_regression
+from sklearn.metrics import log_loss, mean_squared_error, roc_auc_score
+from sklearn.model_selection import train_test_split
+
+import lightgbm_tpu as lgb
+
+
+def _binary_data():
+    X, y = load_breast_cancer(return_X_y=True)
+    return train_test_split(X, y, test_size=0.2, random_state=42)
+
+
+def test_binary_classification():
+    X_tr, X_te, y_tr, y_te = _binary_data()
+    train = lgb.Dataset(X_tr, label=y_tr)
+    params = {"objective": "binary", "metric": "auc", "verbose": -1,
+              "num_leaves": 31, "learning_rate": 0.1, "min_data_in_leaf": 5}
+    bst = lgb.train(params, train, num_boost_round=50)
+    pred = bst.predict(X_te)
+    assert pred.min() >= 0 and pred.max() <= 1
+    auc = roc_auc_score(y_te, pred)
+    assert auc > 0.98, f"AUC {auc} too low"
+    ll = log_loss(y_te, np.clip(pred, 1e-7, 1 - 1e-7))
+    assert ll < 0.2, f"logloss {ll} too high"
+
+
+def test_regression_l2():
+    X, y = make_regression(n_samples=2000, n_features=10, noise=10.0,
+                           random_state=7)
+    X_tr, X_te, y_tr, y_te = train_test_split(X, y, random_state=7)
+    train = lgb.Dataset(X_tr, label=y_tr)
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "min_data_in_leaf": 5}, train, num_boost_round=100)
+    pred = bst.predict(X_te)
+    mse = mean_squared_error(y_te, pred)
+    var = float(np.var(y_te))
+    assert mse < 0.15 * var, f"MSE {mse} vs var {var}"
+
+
+def test_boost_from_average_init():
+    # constant model after 1 round with lr=0 shift: first tree folds mean
+    X, y = make_regression(n_samples=500, n_features=5, random_state=0)
+    y = y + 100.0
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "verbose": -1},
+                    train, num_boost_round=1)
+    pred = bst.predict(X)
+    # predictions centered near mean(y)
+    assert abs(np.mean(pred) - np.mean(y)) < 5.0
+
+
+def test_multiclass():
+    from sklearn.datasets import load_iris
+    X, y = load_iris(return_X_y=True)
+    train = lgb.Dataset(X, label=y)
+    params = {"objective": "multiclass", "num_class": 3, "verbose": -1,
+              "min_data_in_leaf": 5}
+    bst = lgb.train(params, train, num_boost_round=30)
+    pred = bst.predict(X)
+    assert pred.shape == (len(y), 3)
+    np.testing.assert_allclose(pred.sum(axis=1), 1.0, rtol=1e-5)
+    acc = np.mean(np.argmax(pred, axis=1) == y)
+    assert acc > 0.95
+
+
+def test_valid_eval_and_early_stopping():
+    X_tr, X_te, y_tr, y_te = _binary_data()
+    train = lgb.Dataset(X_tr, label=y_tr)
+    valid = lgb.Dataset(X_te, label=y_te, reference=train)
+    evals = {}
+    bst = lgb.train(
+        {"objective": "binary", "metric": ["binary_logloss", "auc"],
+         "verbose": -1, "min_data_in_leaf": 5},
+        train, num_boost_round=200,
+        valid_sets=[valid], valid_names=["va"],
+        callbacks=[lgb.early_stopping(10, verbose=False),
+                   lgb.record_evaluation(evals)])
+    assert bst.best_iteration > 0
+    assert "va" in evals and "auc" in evals["va"]
+    # early stopping should trigger well before 200
+    assert len(evals["va"]["auc"]) <= 200
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    X_tr, X_te, y_tr, y_te = _binary_data()
+    train = lgb.Dataset(X_tr, label=y_tr)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "min_data_in_leaf": 5}, train, num_boost_round=20)
+    pred1 = bst.predict(X_te)
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    bst2 = lgb.Booster(model_file=path)
+    pred2 = bst2.predict(X_te)
+    np.testing.assert_allclose(pred1, pred2, rtol=1e-6)
+    # model text has reference format markers
+    with open(path) as f:
+        content = f.read()
+    assert content.startswith("tree\nversion=v4\n")
+    assert "end of trees" in content
+    assert "feature_importances:" in content
+    assert "end of parameters" in content
+
+
+def test_weights_affect_training():
+    X_tr, X_te, y_tr, y_te = _binary_data()
+    w = np.where(y_tr > 0, 10.0, 1.0)
+    train = lgb.Dataset(X_tr, label=y_tr, weight=w)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "min_data_in_leaf": 5}, train, num_boost_round=20)
+    pred_w = bst.predict(X_te)
+    train2 = lgb.Dataset(X_tr, label=y_tr)
+    bst2 = lgb.train({"objective": "binary", "verbose": -1,
+                      "min_data_in_leaf": 5}, train2, num_boost_round=20)
+    pred = bst2.predict(X_te)
+    # upweighting positives shifts predictions up on average
+    assert np.mean(pred_w) > np.mean(pred)
+
+
+def test_feature_importance():
+    X_tr, X_te, y_tr, y_te = _binary_data()
+    train = lgb.Dataset(X_tr, label=y_tr)
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "min_data_in_leaf": 5}, train, num_boost_round=10)
+    imp_split = bst.feature_importance("split")
+    imp_gain = bst.feature_importance("gain")
+    assert imp_split.shape == (X_tr.shape[1],)
+    assert imp_split.sum() > 0
+    assert imp_gain.sum() > 0
+
+
+def test_deterministic_same_seed():
+    X_tr, X_te, y_tr, y_te = _binary_data()
+    preds = []
+    for _ in range(2):
+        train = lgb.Dataset(X_tr, label=y_tr)
+        bst = lgb.train({"objective": "binary", "verbose": -1,
+                         "min_data_in_leaf": 5, "seed": 17},
+                        train, num_boost_round=10)
+        preds.append(bst.predict(X_te))
+    np.testing.assert_array_equal(preds[0], preds[1])
