@@ -205,6 +205,12 @@ class Booster:
 
         if train_set is not None:
             cfg = resolve_params(self.params)
+            # multi-host bring-up (reference: Booster.__init__ network setup
+            # from the `machines` param, python-package basic.py:3531-3563)
+            if cfg.num_machines > 1 or cfg.machines:
+                from .parallel import init_distributed
+                init_distributed(machines=cfg.machines,
+                                 num_machines=cfg.num_machines)
             train_set.params = {**train_set.params, **self.params} \
                 if train_set._handle is None else train_set.params
             train_set.construct()
@@ -257,7 +263,9 @@ class Booster:
 
     def __inner_raw_score(self) -> np.ndarray:
         import jax
-        s = np.asarray(jax.device_get(self._gbdt.scores))
+        # slice off data-parallel padding rows (scores are [K, N_pad])
+        s = np.asarray(
+            jax.device_get(self._gbdt.scores))[:, :self._gbdt.num_data]
         return s[0] if s.shape[0] == 1 else s.reshape(-1)
 
     def rollback_one_iter(self) -> "Booster":
@@ -311,7 +319,8 @@ class Booster:
         if feval is not None:
             import jax
             if name == "training":
-                score = np.asarray(jax.device_get(self._gbdt.scores))
+                score = np.asarray(
+                    jax.device_get(self._gbdt.scores))[:, :self._gbdt.num_data]
                 dataset = self.train_set
             else:
                 vi = self.name_valid_sets.index(name)

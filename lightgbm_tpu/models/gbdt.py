@@ -90,10 +90,35 @@ class GBDT:
         self.mappers = ds.mappers
         self.real_feature_index = list(ds.real_feature_index)
 
+        # -- device layout: serial (one device) vs data-parallel (rows
+        #    sharded over the mesh `data` axis; reference tree_learner=data,
+        #    SURVEY.md §3.4). feature/voting learners currently run on the
+        #    data-parallel path too: with histograms psum-reduced the voting
+        #    compression and per-rank feature ownership are pure comm
+        #    optimizations, not semantic ones.
+        from ..parallel import make_data_mesh, pad_rows_to
+        n_dev = jax.device_count()
+        self.use_dist = (cfg.tree_learner in ("data", "feature", "voting")
+                         and n_dev > 1)
+        N_real = ds.num_data
+        if self.use_dist:
+            self.mesh = make_data_mesh()
+            self.n_shards = int(self.mesh.devices.size)
+            self.N_pad = pad_rows_to(N_real, self.n_shards)
+            log_info(f"Data-parallel training over {self.n_shards} devices "
+                     f"({N_real} rows padded to {self.N_pad})")
+        else:
+            self.mesh = None
+            self.n_shards = 1
+            self.N_pad = N_real
+
         max_bin = max((m.num_bin for m in ds.mappers), default=2)
         self.num_bins_padded = max(_round_up(max_bin, 8), 8)
         X = ds.X_binned
-        self.X_t = jnp.asarray(np.ascontiguousarray(X.T))   # [F, N]
+        Xt_np = np.ascontiguousarray(X.T)                   # [F, N]
+        if self.N_pad != N_real:
+            Xt_np = np.pad(Xt_np, ((0, 0), (0, self.N_pad - N_real)))
+        self.X_t = self._put_rows(jnp.asarray(Xt_np), row_axis=1)
         self.meta = build_feature_meta(ds)
         self.grow_cfg = GrowConfig(
             num_leaves=cfg.num_leaves,
@@ -112,8 +137,19 @@ class GBDT:
         K = self.num_tree_per_iteration
         N = self.num_data
         md = ds.metadata
-        self.label_dev = jnp.asarray(md.label) if md.label is not None else None
-        self.weight_dev = jnp.asarray(md.weight) if md.weight is not None else None
+
+        def pad1(a):
+            if a is None:
+                return None
+            a = np.asarray(a)
+            if self.N_pad != N:
+                a = np.pad(a, (0, self.N_pad - N))
+            return a
+
+        self.label_dev = (self._put_rows(jnp.asarray(pad1(md.label)))
+                          if md.label is not None else None)
+        self.weight_dev = (self._put_rows(jnp.asarray(pad1(md.weight)))
+                           if md.weight is not None else None)
 
         # initial scores (Metadata::init_score, c.f. score_updater.hpp:27-47)
         scores = np.zeros((K, N), dtype=np.float32)
@@ -123,7 +159,9 @@ class GBDT:
             self._has_init_score = True
         else:
             self._has_init_score = False
-        self.scores = jnp.asarray(scores)
+        if self.N_pad != N:
+            scores = np.pad(scores, ((0, 0), (0, self.N_pad - N)))
+        self.scores = self._put_rows(jnp.asarray(scores), row_axis=1)
 
         if self.objective is not None:
             self.objective.init(md, N)
@@ -133,24 +171,36 @@ class GBDT:
         # sample strategy (bagging / goss), reference: sample_strategy.cpp:16
         from .sample_strategy import create_sample_strategy
         self.sample_strategy = create_sample_strategy(cfg, N, md)
+        self._in_bag_dev = None
 
         self._build_jit_fns()
+
+    def _put_rows(self, arr: jnp.ndarray, row_axis: int = 0) -> jnp.ndarray:
+        """Shard `arr` rows over the mesh data axis (no-op when serial)."""
+        if not self.use_dist:
+            return arr
+        from ..parallel import shard_rows
+        return shard_rows(self.mesh, arr, row_axis=row_axis)
 
     def _build_jit_fns(self) -> None:
         cfg_static = self.grow_cfg
         meta = self.meta
-        shrinkage_is_one = self.config.boosting == "rf"
 
-        @jax.jit
-        def train_tree(X_t, grad, hess, in_bag, scores_k, lr, feat_mask):
-            tree, leaf_of_row = grow_tree(
-                X_t, grad, hess, in_bag, meta, cfg_static,
-                feature_mask=feat_mask)
-            leaf_shrunk = tree.leaf_value * lr
-            new_scores = scores_k + leaf_shrunk[leaf_of_row]
-            return tree, leaf_of_row, new_scores
+        if self.use_dist:
+            from ..parallel import build_data_parallel_train_fn
+            self._train_tree = build_data_parallel_train_fn(
+                self.mesh, meta, cfg_static)
+        else:
+            @jax.jit
+            def train_tree(X_t, grad, hess, in_bag, scores_k, lr, feat_mask):
+                tree, leaf_of_row = grow_tree(
+                    X_t, grad, hess, in_bag, meta, cfg_static,
+                    feature_mask=feat_mask)
+                leaf_shrunk = tree.leaf_value * lr
+                new_scores = scores_k + leaf_shrunk[leaf_of_row]
+                return tree, leaf_of_row, new_scores
 
-        self._train_tree = train_tree
+            self._train_tree = train_tree
 
         @jax.jit
         def valid_update(split_feature, threshold_bin, default_left,
@@ -209,11 +259,23 @@ class GBDT:
         if self.objective is None:
             log_fatal("No objective function provided for boosting")
         if self.objective.runs_on_host:
-            score_np = np.asarray(jax.device_get(self.scores))
+            # NOTE(multi-host): device_get on a row-sharded array only works
+            # when all shards are process-addressable (single-host meshes).
+            # The multi-host runner will keep host reads per-process-local
+            # (each process computes gradients for its own row shard, like
+            # the reference's per-rank Metadata) — tracked for round 2.
+            score_np = np.asarray(
+                jax.device_get(self.scores))[:, :self.num_data]
             g, h = self.objective.get_gradients_numpy(score_np.reshape(-1))
             K = self.num_tree_per_iteration
-            return (jnp.asarray(g.reshape(K, -1)),
-                    jnp.asarray(h.reshape(K, -1)))
+            g = g.reshape(K, -1)
+            h = h.reshape(K, -1)
+            if self.N_pad != self.num_data:
+                pad = ((0, 0), (0, self.N_pad - self.num_data))
+                g = np.pad(g, pad)
+                h = np.pad(h, pad)
+            return (self._put_rows(jnp.asarray(g), row_axis=1),
+                    self._put_rows(jnp.asarray(h), row_axis=1))
         return self._grad_fn(self.scores, self.label_dev, self.weight_dev)
 
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
@@ -229,9 +291,28 @@ class GBDT:
         else:
             grad = np.asarray(grad, np.float32).reshape(K, -1)
             hess = np.asarray(hess, np.float32).reshape(K, -1)
-            g_dev, h_dev = jnp.asarray(grad), jnp.asarray(hess)
+            if self.N_pad != self.num_data:
+                pad = ((0, 0), (0, self.N_pad - self.num_data))
+                grad = np.pad(grad, pad)
+                hess = np.pad(hess, pad)
+            g_dev = self._put_rows(jnp.asarray(grad), row_axis=1)
+            h_dev = self._put_rows(jnp.asarray(hess), row_axis=1)
 
-        in_bag = self.sample_strategy.sample(self.iter, g_dev, h_dev)
+        strat = self.sample_strategy
+        if self._in_bag_dev is None or strat.resamples_at(self.iter):
+            if strat.needs_grad:
+                g_arg = g_dev[:, :self.num_data]
+                h_arg = h_dev[:, :self.num_data]
+            else:
+                g_arg = h_arg = None
+            in_bag = strat.sample(self.iter, g_arg, h_arg)
+            if self.N_pad != self.num_data:
+                padding = [(0, 0)] * (in_bag.ndim - 1) + \
+                    [(0, self.N_pad - self.num_data)]
+                in_bag = jnp.pad(in_bag, padding)
+            self._in_bag_dev = self._put_rows(in_bag,
+                                              row_axis=in_bag.ndim - 1)
+        in_bag = self._in_bag_dev
 
         lr = jnp.float32(self.shrinkage_rate)
         feat_mask = self._feature_mask_for_iter()
@@ -298,7 +379,9 @@ class GBDT:
         frac = self.config.feature_fraction
         F = len(self.mappers)
         if frac >= 1.0:
-            return None
+            # shard_map needs a stable pytree: always pass an array when
+            # distributed
+            return jnp.ones((F,), bool) if self.use_dist else None
         used = max(1, int(round(F * frac)))
         rng = np.random.RandomState(
             self.config.feature_fraction_seed + self.iter)
@@ -374,7 +457,8 @@ class GBDT:
         out = []
         for name, metrics in metrics_per_set.items():
             if name == "training":
-                score = np.asarray(jax.device_get(self.scores))
+                score = np.asarray(
+                    jax.device_get(self.scores))[:, :self.num_data]
             else:
                 vi = self.valid_names.index(name)
                 score = np.asarray(jax.device_get(self._valid_scores[vi]))

@@ -26,11 +26,17 @@ from ..utils.log import log_info, log_warning
 
 class SampleStrategy:
     is_hessian_change = False
+    needs_grad = False       # True when sample() actually reads grad/hess
 
     def __init__(self, config: Config, num_data: int, metadata):
         self.config = config
         self.num_data = num_data
         self.metadata = metadata
+
+    def resamples_at(self, it: int) -> bool:
+        """Whether sample() would produce a new mask at iteration `it`
+        (lets the trainer cache the padded/sharded mask otherwise)."""
+        return False
 
     def sample(self, it: int, grad: jnp.ndarray, hess: jnp.ndarray
                ) -> jnp.ndarray:
@@ -56,6 +62,9 @@ class BaggingSampleStrategy(SampleStrategy):
     def _need_resample(self, it: int) -> bool:
         freq = max(self.config.bagging_freq, 1)
         return self._cached is None or it % freq == 0
+
+    def resamples_at(self, it: int) -> bool:
+        return self._need_resample(it)
 
     def sample(self, it, grad, hess):
         if not self._need_resample(it):
@@ -84,6 +93,7 @@ class GOSSStrategy(SampleStrategy):
     and amplify them by (1 - top_rate) / other_rate."""
 
     is_hessian_change = True
+    needs_grad = True
 
     def __init__(self, config: Config, num_data: int, metadata):
         super().__init__(config, num_data, metadata)
@@ -93,6 +103,9 @@ class GOSSStrategy(SampleStrategy):
         self.warmup_iters = int(1.0 / config.learning_rate)
         seed = config.data_random_seed
         self._key = jax.random.PRNGKey(seed)
+
+    def resamples_at(self, it: int) -> bool:
+        return True
 
     def sample(self, it, grad, hess):
         if it < self.warmup_iters:

@@ -1,0 +1,75 @@
+"""Distributed context: the TPU-native analog of the reference's Network
+layer (include/LightGBM/network.h:90, src/network/network.cpp).
+
+The reference implements its own socket/MPI collectives (Allreduce,
+ReduceScatter, Allgather over Bruck / recursive-halving topologies,
+network.h:279-291) and exposes an external-collective injection point
+(LGBM_NetworkInitWithFunctions, c_api.h:1674). On TPU the entire layer
+collapses into XLA collectives over ICI/DCN: `psum` IS the histogram
+Allreduce of the data-parallel learner (data_parallel_tree_learner.cpp:286),
+`pmax`/`pmin` are GlobalSyncUpByMax/Min (network.h:170-241).
+
+`DistContext` is carried into jitted code (it is a static NamedTuple of
+strings) and its methods are only valid inside `shard_map`-traced functions
+over the owning mesh.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DATA_AXIS = "data"
+FEATURE_AXIS = "feature"
+
+
+class DistContext(NamedTuple):
+    """Mesh-axis handle used by device code (static; part of the jit key)."""
+    axis_name: str = DATA_AXIS
+
+    # -- Network::Allreduce(SUM) analog (network.h:117)
+    def psum(self, x):
+        return jax.lax.psum(x, self.axis_name)
+
+    # -- Network::GlobalSyncUpByMax (network.h:190)
+    def pmax(self, x):
+        return jax.lax.pmax(x, self.axis_name)
+
+    # -- Network::GlobalSyncUpByMin (network.h:170)
+    def pmin(self, x):
+        return jax.lax.pmin(x, self.axis_name)
+
+    # -- Network::GlobalSyncUpByMean (network.h:210)
+    def pmean(self, x):
+        return jax.lax.pmean(x, self.axis_name)
+
+    # -- Network::Allgather (network.h:139)
+    def all_gather(self, x, axis: int = 0, tiled: bool = True):
+        return jax.lax.all_gather(x, self.axis_name, axis=axis, tiled=tiled)
+
+    # -- Network::ReduceScatter (network.h:165): the reference reduce-scatters
+    # histogram buffers so each rank owns one feature slice; psum_scatter is
+    # the literal XLA equivalent riding ICI.
+    def psum_scatter(self, x, axis: int = 0, tiled: bool = True):
+        return jax.lax.psum_scatter(x, self.axis_name, scatter_dimension=axis,
+                                    tiled=tiled)
+
+    def axis_index(self):
+        return jax.lax.axis_index(self.axis_name)
+
+    def axis_size(self):
+        return jax.lax.axis_size(self.axis_name)
+
+
+def make_data_mesh(num_devices: int = 0,
+                   devices: Optional[Sequence] = None) -> jax.sharding.Mesh:
+    """1-D mesh over the data axis (rows sharded, model replicated) — the
+    layout of the reference's tree_learner=data (SURVEY.md §3.4)."""
+    if devices is None:
+        devices = jax.devices()
+        if num_devices:
+            devices = devices[:num_devices]
+    return jax.sharding.Mesh(np.asarray(devices), (DATA_AXIS,))

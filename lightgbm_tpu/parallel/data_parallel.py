@@ -1,0 +1,77 @@
+"""Data-parallel tree training over a device mesh.
+
+TPU-native re-design of DataParallelTreeLearner
+(src/treelearner/data_parallel_tree_learner.cpp): rows are sharded across the
+mesh `data` axis; each device builds histograms on its local shard; the
+histogram Allreduce (reference: Network::ReduceScatter of histogram buffers +
+Allgather of best splits, data_parallel_tree_learner.cpp:286-298 and
+SyncUpGlobalBestSplit, parallel_tree_learner.h:210-233) becomes a single
+`psum` over ICI inside the grower. Split selection then happens redundantly
+but identically on every device, which reproduces the reference invariant:
+every rank executes the same splits and grows the IDENTICAL tree
+(SURVEY.md §3.4) — no split-record broadcast is needed at all.
+
+The whole per-tree loop stays inside ONE jitted shard_map computation; the
+only cross-device traffic is the per-split histogram psum (O(F·B·6) floats)
+and scalar root reductions, exactly the wire profile of the reference's
+tree_learner=data.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops.grow import GrowConfig, grow_tree
+from ..ops.split import FeatureMeta
+from .context import DATA_AXIS, DistContext
+
+
+def pad_rows_to(n: int, num_shards: int, multiple: int = 8) -> int:
+    """Rows must split evenly across shards (and pad to a lane-friendly
+    multiple per shard so XLA tiles cleanly)."""
+    per = -(-n // num_shards)
+    per = -(-per // multiple) * multiple
+    return per * num_shards
+
+
+def build_data_parallel_train_fn(mesh: jax.sharding.Mesh,
+                                 meta: FeatureMeta,
+                                 cfg: GrowConfig):
+    """Returns jit(train_step) with the same signature as the serial
+    `_train_tree` in models/gbdt.py:
+
+        (X_t [F,N], grad [N], hess [N], in_bag [N], scores_k [N], lr, mask[F])
+        -> (DeviceTree replicated, leaf_of_row [N], new_scores [N])
+
+    N must be divisible by the mesh's data-axis size (pad with in_bag == 0
+    rows via `pad_rows_to`).
+    """
+    dist = DistContext(DATA_AXIS)
+
+    def step(X_t, grad, hess, in_bag, scores_k, lr, feat_mask):
+        tree, leaf_of_row = grow_tree(
+            X_t, grad, hess, in_bag, meta, cfg,
+            feature_mask=feat_mask, dist=dist)
+        new_scores = scores_k + (tree.leaf_value * lr)[leaf_of_row]
+        return tree, leaf_of_row, new_scores
+
+    row = P(DATA_AXIS)
+    rep = P()
+    sharded = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(None, DATA_AXIS), row, row, row, row, rep, rep),
+        out_specs=(rep, row, row),
+        check_vma=False)
+    return jax.jit(sharded)
+
+
+def shard_rows(mesh: jax.sharding.Mesh, arr, row_axis: int = 0):
+    """Place an array with rows sharded over the mesh data axis."""
+    spec = [None] * arr.ndim
+    spec[row_axis] = DATA_AXIS
+    return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+
+
+def replicated(mesh: jax.sharding.Mesh, arr):
+    return jax.device_put(arr, NamedSharding(mesh, P()))

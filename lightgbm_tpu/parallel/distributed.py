@@ -1,0 +1,75 @@
+"""Multi-host bring-up.
+
+The reference builds a TCP mesh from a `machines` list
+(src/network/linkers_socket.cpp:26: parse machine list, bind/listen,
+point-to-point connect) or uses MPI (linkers_mpi.cpp). On TPU pods the
+transport is owned by the runtime: `jax.distributed.initialize` wires all
+hosts into one JAX process group and `jax.devices()` then spans the whole
+slice; collectives ride ICI within a slice and DCN across slices with no
+user-level linker code.
+
+This module keeps the reference's *API shape* (machines / num_machines /
+local_listen_port, Config fields of the same names, python-package
+basic.py:3531-3563) while mapping it onto the JAX runtime.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..utils.log import log_fatal, log_info
+
+_initialized = False
+
+
+def init_distributed(machines: str = "",
+                     num_machines: int = 1,
+                     machine_rank: Optional[int] = None,
+                     coordinator_address: Optional[str] = None) -> None:
+    """Initialize multi-host JAX (reference: Network::Init, network.cpp:34).
+
+    `machines` is the reference-style comma-separated "ip:port,ip:port,..."
+    list; the first entry becomes the coordinator. Alternatively pass
+    `coordinator_address` directly. No-op for num_machines <= 1 or when the
+    runtime was already initialized (e.g. by the launcher).
+    """
+    global _initialized
+    if _initialized or num_machines <= 1 and not machines:
+        return
+    if coordinator_address is None and machines:
+        entries = [m.strip() for m in machines.split(",") if m.strip()]
+        num_machines = max(num_machines, len(entries))
+        coordinator_address = entries[0]
+    if num_machines <= 1:
+        return
+    if machine_rank is None:
+        rank_env = os.environ.get("LIGHTGBM_TPU_RANK")
+        if rank_env is None:
+            # defaulting every host to rank 0 would deadlock the coordinator
+            # (all processes claiming process_id 0); the reference fatals on
+            # network-init failure (linkers_socket.cpp bind/connect) — so do we
+            log_fatal(
+                "num_machines > 1 but no machine rank given: set the "
+                "LIGHTGBM_TPU_RANK env var (0..num_machines-1) or pass "
+                "machine_rank")
+        machine_rank = int(rank_env)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_machines,
+            process_id=machine_rank)
+        _initialized = True
+        log_info(f"Distributed init: rank {machine_rank}/{num_machines} "
+                 f"coordinator {coordinator_address}; "
+                 f"{jax.device_count()} global devices")
+    except RuntimeError as e:
+        if "already" in str(e).lower():
+            # benign: the launcher (or a previous Booster) initialized the
+            # process group
+            _initialized = True
+            log_info(f"jax.distributed already initialized: {e}")
+        else:
+            raise
