@@ -1,0 +1,63 @@
+"""Benchmark: single-chip training throughput on a Higgs-like binary task.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's published CPU Higgs number — 10.5M train rows x
+500 iterations in 130.094 s on 2x E5-2690 v4 (docs/Experiments.rst:113,
+BASELINE.md) = 4.04e7 row-iterations/s. vs_baseline > 1 means this TPU
+build trains faster than the reference's 28-thread CPU run.
+
+Config mirrors the reference experiment shape (binary objective, 255
+leaves, 255 bins) on a synthetic dense matrix; rows/features/iters are
+scaled by BENCH_ROWS / BENCH_COLS / BENCH_ITERS env vars so the same
+script runs on CPU smoke tests and the real chip.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+BASELINE_ROW_ITERS_PER_SEC = 10_500_000 * 500 / 130.094
+
+
+def main() -> None:
+    rows = int(os.environ.get("BENCH_ROWS", "500000"))
+    cols = int(os.environ.get("BENCH_COLS", "28"))
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    num_leaves = int(os.environ.get("BENCH_LEAVES", "255"))
+
+    rng = np.random.RandomState(42)
+    X = rng.normal(size=(rows, cols)).astype(np.float32)
+    w = rng.normal(size=cols)
+    y = (X @ w + rng.normal(scale=0.5, size=rows) > 0).astype(np.float32)
+
+    import lightgbm_tpu as lgb
+
+    params = dict(objective="binary", num_leaves=num_leaves, max_bin=255,
+                  learning_rate=0.1, min_data_in_leaf=20, verbose=-1,
+                  bagging_freq=0)
+    ds = lgb.Dataset(X, label=y)
+
+    # warmup: one full boosting iteration to trigger jit compilation
+    booster = lgb.Booster(params=params, train_set=ds)
+    booster.update()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        booster.update()
+    dt = time.perf_counter() - t0
+
+    row_iters_per_sec = rows * iters / dt
+    print(json.dumps({
+        "metric": "binary_train_throughput",
+        "value": round(row_iters_per_sec, 1),
+        "unit": "row_iters_per_sec",
+        "vs_baseline": round(row_iters_per_sec / BASELINE_ROW_ITERS_PER_SEC,
+                             4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
