@@ -35,8 +35,7 @@ _KEPS = 1e-15
 MODEL_VERSION = "v4"
 
 
-def _round_up(x: int, m: int) -> int:
-    return (x + m - 1) // m * m
+from ..utils import round_up as _round_up
 
 
 def build_feature_meta(ds: BinnedDataset) -> FeatureMeta:

@@ -21,12 +21,35 @@ column reads (partition updates, ops/grow.py) are contiguous slices.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
 
-def _round_up(x: int, m: int) -> int:
-    return (x + m - 1) // m * m
+from ..utils import round_up as _round_up
+
+
+def _use_pallas(X_binned_t: jnp.ndarray, vals: jnp.ndarray,
+                num_bins: int) -> bool:
+    """Fused Pallas kernel on real TPU backends; XLA lowering elsewhere
+    (CPU test meshes, >8-bit bins, >8 channels).
+
+    The env-var kill switch is read at TRACE time: it must be set before the
+    first training step of the process (the jit cache is not keyed on it).
+    """
+    if os.environ.get("LIGHTGBM_TPU_DISABLE_PALLAS", "").lower() \
+            in ("1", "true", "yes"):
+        return False
+    if num_bins > 256 or X_binned_t.dtype not in (jnp.uint8, jnp.int8):
+        return False
+    from .histogram_pallas import C_PAD
+    if vals.shape[1] > C_PAD:
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False
 
 
 def build_histogram(
@@ -42,6 +65,16 @@ def build_histogram(
     bag. Rows are processed in chunks under `lax.scan` so the materialized
     one-hot block stays in VMEM-sized pieces.
     """
+    if _use_pallas(X_binned_t, vals, num_bins):
+        from .histogram_pallas import build_histogram_pallas
+        return build_histogram_pallas(X_binned_t, vals, num_bins)
+    return _build_histogram_xla(X_binned_t, vals, num_bins, rows_per_chunk,
+                                dtype)
+
+
+def _build_histogram_xla(X_binned_t, vals, num_bins, rows_per_chunk=8192,
+                         dtype=jnp.float32):
+    """Portable XLA lowering (also the pinned reference in kernel tests)."""
     F, N = X_binned_t.shape
     C = vals.shape[1]
     B = num_bins

@@ -1,1 +1,6 @@
+"""Shared small utilities (reference: include/LightGBM/utils/common.h)."""
 
+
+def round_up(x: int, m: int) -> int:
+    """Smallest multiple of `m` that is >= `x`."""
+    return (x + m - 1) // m * m
