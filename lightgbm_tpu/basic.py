@@ -220,8 +220,9 @@ class Booster:
             self._train_metrics = [
                 m for m in (create_metric(n, cfg) for n in metric_names)
                 if m is not None]
-            self._gbdt = GBDT(cfg, train_set._handle, objective,
-                              self._train_metrics)
+            from .models import create_boosting
+            self._gbdt = create_boosting(cfg, train_set._handle, objective,
+                                         self._train_metrics)
             self.train_set = train_set
             self._config = cfg
             self._metric_names = metric_names
@@ -374,6 +375,178 @@ class Booster:
     def model_from_string(self, model_str: str) -> "Booster":
         self._gbdt = GBDT.load_model_from_string(model_str)
         return self
+
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0,
+                   importance_type: str = "split") -> Dict[str, Any]:
+        """JSON model dump (reference: GBDT::DumpModel,
+        gbdt_model_text.cpp:31)."""
+        g = self._gbdt
+        ni = num_iteration if num_iteration is not None else (
+            self.best_iteration if self.best_iteration > 0 else -1)
+        K = g.num_tree_per_iteration
+        total_iters = len(g.models) // K if K else 0
+        end = total_iters if ni <= 0 else min(total_iters,
+                                              start_iteration + ni)
+        trees = []
+        for it in range(start_iteration, end):
+            for k in range(K):
+                d = g.models[it * K + k].to_json()
+                d["tree_index"] = len(trees)
+                trees.append(d)
+        return {
+            "name": "tree",
+            "version": "v4",
+            "num_class": g.num_class,
+            "num_tree_per_iteration": K,
+            "label_index": g.label_idx_,
+            "max_feature_idx": g.max_feature_idx_,
+            "objective": (g.objective.to_string() if g.objective else ""),
+            "average_output": g.average_output,
+            "feature_names": list(g.feature_names_),
+            "feature_importances": {
+                name: float(v) for name, v in zip(
+                    g.feature_names_,
+                    g.feature_importance(
+                        0 if importance_type == "split" else 1))
+                if v > 0},
+            "tree_info": trees,
+        }
+
+    def refit(self, data, label, decay_rate: float = 0.9,
+              **kwargs) -> "Booster":
+        """Refit existing tree structures to new data, returning a NEW
+        Booster (the original is unchanged, like the reference python
+        Booster.refit; leaf math per GBDT::RefitTree, gbdt.cpp:200-228):
+        each leaf value becomes decay_rate * old + (1 - decay_rate) * new,
+        where `new` is the regularized leaf output of the new data's
+        gradients falling in that leaf."""
+        data = _to_2d_numpy(data)
+        new_booster = Booster(model_str=self.model_to_string())
+        g = new_booster._gbdt
+        if g.objective is None:
+            raise ValueError("Cannot refit a model without an objective")
+        label = np.asarray(label, np.float32).reshape(-1)
+        K = g.num_tree_per_iteration
+        N = data.shape[0]
+        # leaf assignment per tree for the new data
+        leaf_preds = self.predict(data, pred_leaf=True).reshape(N, -1)
+        from .data.dataset import Metadata
+        md = Metadata(N)
+        md.set_label(label)
+        g.objective.init(md, N)
+        scores = np.zeros((K, N), dtype=np.float64)
+        cfg = g.config
+        for mi, tree in enumerate(g.models):
+            k = mi % K
+            import jax.numpy as jnp
+            if g.objective.runs_on_host:
+                grad, hess = g.objective.get_gradients_numpy(
+                    scores.reshape(-1).astype(np.float64))
+                grad = grad.reshape(K, N)[k]
+                hess = hess.reshape(K, N)[k]
+            else:
+                gg, hh = g.objective.get_gradients(
+                    jnp.asarray(scores[k], jnp.float32)
+                    if K == 1 else jnp.asarray(scores, jnp.float32),
+                    jnp.asarray(label), None)
+                grad = np.asarray(gg).reshape(K, -1)[k] \
+                    if np.asarray(gg).ndim > 1 else np.asarray(gg)
+                hess = np.asarray(hh).reshape(K, -1)[k] \
+                    if np.asarray(hh).ndim > 1 else np.asarray(hh)
+            leaf = leaf_preds[:, mi]
+            nl = tree.num_leaves
+            sum_g = np.bincount(leaf, weights=grad, minlength=nl)
+            sum_h = np.bincount(leaf, weights=hess, minlength=nl)
+            reg = np.abs(sum_g) - cfg.lambda_l1
+            new_val = -np.sign(sum_g) * np.maximum(reg, 0.0) / (
+                sum_h + cfg.lambda_l2 + 1e-15)
+            new_val *= tree.shrinkage
+            tree.leaf_value = (decay_rate * tree.leaf_value
+                               + (1.0 - decay_rate) * new_val[:nl])
+            scores[k] += tree.leaf_value[leaf]
+        return new_booster
+
+    def dump_model_to_cpp(self) -> str:
+        """C++ if-else codegen (reference: GBDT::SaveModelToIfElse,
+        gbdt_model_text.cpp:262). Handles missing semantics (None/Zero/NaN
+        per Tree::NumericalDecision, tree.h:375-407) and categorical bitset
+        splits (Tree::CategoricalDecision)."""
+        g = self._gbdt
+        lines = ["#include <cmath>", "#include <cstdint>", "",
+                 f"// generated by lightgbm_tpu; {len(g.models)} trees"]
+        for i, tree in enumerate(g.models):
+            # constant bitset tables for this tree's categorical splits
+            if tree.num_cat > 0:
+                for ci in range(tree.num_cat):
+                    s0 = int(tree.cat_boundaries[ci])
+                    s1 = int(tree.cat_boundaries[ci + 1])
+                    words = ", ".join(
+                        f"{int(w)}u" for w in tree.cat_threshold[s0:s1])
+                    lines.append(
+                        f"static const uint32_t kCatBits{i}_{ci}[] = "
+                        f"{{{words}}};")
+            lines.append(f"double PredictTree{i}(const double* arr) {{")
+            if tree.num_leaves <= 1:
+                lines.append(f"  return {float(tree.leaf_value[0])!r};")
+            else:
+                def emit(node, depth):
+                    ind = "  " * (depth + 1)
+                    if node < 0:
+                        lines.append(
+                            f"{ind}return "
+                            f"{float(tree.leaf_value[~node])!r};")
+                        return
+                    f = int(tree.split_feature[node])
+                    dt = int(tree.decision_type[node])
+                    is_cat = bool(dt & 1)
+                    default_left = bool(dt & 2)
+                    missing_type = (dt >> 2) & 3
+                    if is_cat:
+                        # CategoricalDecision: NaN / negative / out-of-range
+                        # fall right; otherwise bitset membership
+                        ci = int(tree.threshold_in_bin[node])
+                        nwords = int(tree.cat_boundaries[ci + 1]
+                                     - tree.cat_boundaries[ci])
+                        cond = (
+                            f"(!std::isnan(arr[{f}]) && arr[{f}] >= 0 && "
+                            f"static_cast<int>(arr[{f}]) < {nwords * 32} && "
+                            f"((kCatBits{i}_{ci}"
+                            f"[static_cast<int>(arr[{f}]) / 32] >> "
+                            f"(static_cast<int>(arr[{f}]) % 32)) & 1))")
+                    else:
+                        thr = float(tree.threshold[node])
+                        # NumericalDecision: NaN -> 0 unless missing_type is
+                        # NaN; Zero-missing follows the default direction
+                        val = f"(std::isnan(arr[{f}]) ? 0.0 : arr[{f}])"
+                        if missing_type == 2:       # MissingType::NaN
+                            miss = f"std::isnan(arr[{f}])"
+                            val = f"arr[{f}]"
+                        elif missing_type == 1:     # MissingType::Zero
+                            miss = f"(std::fabs({val}) <= 1e-35)"
+                        else:
+                            miss = "false"
+                        dirn = "true" if default_left else "false"
+                        cond = (f"({miss} ? {dirn} : "
+                                f"({val} <= {thr!r}))")
+                    lines.append(f"{ind}if {cond} {{")
+                    emit(int(tree.left_child[node]), depth + 1)
+                    lines.append(f"{ind}}} else {{")
+                    emit(int(tree.right_child[node]), depth + 1)
+                    lines.append(f"{ind}}}")
+                emit(0, 0)
+            lines.append("}")
+            lines.append("")
+        n = len(g.models)
+        lines.append("double Predict(const double* arr) {")
+        lines.append("  double result = 0.0;")
+        for i in range(n):
+            lines.append(f"  result += PredictTree{i}(arr);")
+        if g.average_output and n:
+            lines.append(f"  result /= {n};")
+        lines.append("  return result;")
+        lines.append("}")
+        return "\n".join(lines) + "\n"
 
     def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
         """reference: basic.py Booster.reset_parameter (supports the

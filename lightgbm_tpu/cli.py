@@ -1,0 +1,147 @@
+"""Command-line application driver.
+
+Mirrors the reference CLI (src/main.cpp + src/application/application.cpp):
+`lightgbm_tpu config=train.conf [key=value ...]` with
+task = train | predict | refit | save_binary | convert_model.
+Config files are `key = value` lines with `#` comments
+(reference: Application::LoadParameters, application.cpp:54).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import resolve_params
+from .data.loader import load_text_file
+from .engine import train as engine_train
+from .utils.log import log_fatal, log_info
+
+
+def parse_config_file(path: str) -> Dict[str, str]:
+    """reference: Application::LoadParameters reads key=value lines."""
+    out: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def parse_args(argv: List[str]) -> Dict[str, str]:
+    from .config import canonical_name
+    params: Dict[str, str] = {}
+    for arg in argv:
+        if "=" not in arg:
+            log_fatal(f"Unknown CLI argument: {arg} (expected key=value)")
+        k, v = arg.split("=", 1)
+        params[canonical_name(k.strip())] = v.strip()
+    if "config" in params:
+        file_params = {canonical_name(k): v for k, v in
+                       parse_config_file(params.pop("config")).items()}
+        # command-line overrides config file (application.cpp:64-68);
+        # canonical keys so an aliased CLI arg beats its config-file twin
+        file_params.update(params)
+        params = file_params
+    return params
+
+
+def _load_dataset_from_config(cfg, path: str,
+                              reference: Optional[Dataset] = None) -> Dataset:
+    X, y, w, group, names = load_text_file(
+        path, has_header=cfg.header, label_column=cfg.label_column,
+        weight_column=cfg.weight_column, group_column=cfg.group_column,
+        ignore_column=cfg.ignore_column)
+    if reference is not None:
+        return reference.create_valid(X, label=y, weight=w, group=group)
+    return Dataset(X, label=y, weight=w, group=group,
+                   feature_name=list(names))
+
+
+def run_train(params: Dict[str, Any], cfg) -> None:
+    train_set = _load_dataset_from_config(cfg, cfg.data)
+    valid_sets = []
+    valid_names = []
+    valid_paths = cfg.valid if isinstance(cfg.valid, list) else (
+        [v for v in str(cfg.valid).split(",") if v])
+    for vp in valid_paths:
+        valid_sets.append(_load_dataset_from_config(cfg, vp, train_set))
+        valid_names.append(vp.rsplit("/", 1)[-1])
+    init_model = cfg.input_model if cfg.input_model else None
+    booster = engine_train(params, train_set,
+                           num_boost_round=cfg.num_iterations,
+                           valid_sets=valid_sets, valid_names=valid_names,
+                           init_model=init_model)
+    booster.save_model(cfg.output_model)
+    log_info(f"Finished training; model saved to {cfg.output_model}")
+
+
+def run_predict(params: Dict[str, Any], cfg) -> None:
+    if not cfg.input_model:
+        log_fatal("task=predict requires input_model")
+    booster = Booster(model_file=cfg.input_model)
+    X, _, _, _, _ = load_text_file(
+        cfg.data, has_header=cfg.header, label_column=cfg.label_column,
+        ignore_column=cfg.ignore_column)
+    pred = booster.predict(
+        X, raw_score=cfg.predict_raw_score,
+        pred_leaf=cfg.predict_leaf_index, pred_contrib=cfg.predict_contrib,
+        start_iteration=cfg.start_iteration_predict,
+        num_iteration=cfg.num_iteration_predict)
+    out = np.asarray(pred)
+    if out.ndim == 1:
+        out = out[:, None]
+    np.savetxt(cfg.output_result, out, delimiter="\t", fmt="%.18g")
+    log_info(f"Finished prediction; results saved to {cfg.output_result}")
+
+
+def run_refit(params: Dict[str, Any], cfg) -> None:
+    if not cfg.input_model:
+        log_fatal("task=refit requires input_model")
+    booster = Booster(model_file=cfg.input_model)
+    X, y, _, _, _ = load_text_file(
+        cfg.data, has_header=cfg.header, label_column=cfg.label_column,
+        ignore_column=cfg.ignore_column)
+    booster = booster.refit(X, y, decay_rate=cfg.refit_decay_rate)
+    booster.save_model(cfg.output_model)
+    log_info(f"Finished refit; model saved to {cfg.output_model}")
+
+
+def run_convert_model(params: Dict[str, Any], cfg) -> None:
+    if not cfg.input_model:
+        log_fatal("task=convert_model requires input_model")
+    booster = Booster(model_file=cfg.input_model)
+    out = cfg.convert_model if getattr(cfg, "convert_model", "") else \
+        "gbdt_prediction.cpp"
+    with open(out, "w") as f:
+        f.write(booster.dump_model_to_cpp())
+    log_info(f"Finished converting model; saved to {out}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    params = parse_args(argv)
+    cfg = resolve_params(dict(params))
+    task = cfg.task
+    log_info(f"lightgbm_tpu CLI: task={task}")
+    if task == "train":
+        run_train(params, cfg)
+    elif task in ("predict", "prediction", "test"):
+        run_predict(params, cfg)
+    elif task == "refit":
+        run_refit(params, cfg)
+    elif task == "convert_model":
+        run_convert_model(params, cfg)
+    else:
+        log_fatal(f"Unknown task: {task}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

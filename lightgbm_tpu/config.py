@@ -153,6 +153,8 @@ class Config:
 
     # -- core
     task: str = "train"
+    data: str = ""
+    valid: Union[str, List[str]] = ""
     objective: str = "regression"
     boosting: str = "gbdt"
     data_sample_strategy: str = "bagging"
@@ -394,6 +396,11 @@ def _coerce(name: str, value: Any) -> Any:
     if isinstance(default, float):
         return float(value)
     return value
+
+
+def canonical_name(key: str) -> str:
+    """Resolve a parameter alias to its canonical name."""
+    return _ALIASES.get(key, key)
 
 
 def resolve_params(

@@ -1,0 +1,134 @@
+"""Text data loading: CSV / TSV / LibSVM with format autodetection.
+
+Python analog of the reference parser layer (src/io/parser.cpp
+Parser::CreateParser autodetection, include/LightGBM/dataset.h:406) and the
+loader's label/ignore column handling (src/io/dataset_loader.cpp:200-320).
+The native C++ fast path for huge files lives in native/ (used when built);
+this module is the portable fallback and the semantics reference.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.log import log_fatal, log_info
+
+
+def _detect_format(first_lines: List[str]) -> str:
+    """'libsvm' | 'csv' | 'tsv' (reference: Parser::CreateParser samples the
+    first lines and counts separators)."""
+    for ln in first_lines:
+        toks = ln.split()
+        if len(toks) >= 2 and all(":" in t for t in toks[1:3] if t):
+            return "libsvm"
+    head = first_lines[0] if first_lines else ""
+    if head.count("\t") >= head.count(","):
+        return "tsv" if "\t" in head else "csv"
+    return "csv"
+
+
+def _parse_column_spec(spec: str, header_names: Optional[List[str]]) -> int:
+    """'0' | 'name:<col>' (reference: config column specifiers)."""
+    if spec.startswith("name:"):
+        name = spec[5:]
+        if not header_names or name not in header_names:
+            log_fatal(f"Column name {name} not found in header")
+        return header_names.index(name)
+    return int(spec)
+
+
+def load_text_file(path: str, has_header: bool = False,
+                   label_column: str = "", weight_column: str = "",
+                   group_column: str = "", ignore_column: str = "",
+                   ) -> Tuple[np.ndarray, Optional[np.ndarray],
+                              Optional[np.ndarray], Optional[np.ndarray],
+                              List[str]]:
+    """Returns (X, label, weight, group_sizes, feature_names)."""
+    if not os.path.exists(path):
+        log_fatal(f"Data file {path} does not exist")
+    with open(path) as f:
+        lines = [ln.rstrip("\n") for ln in f if ln.strip()]
+    if not lines:
+        log_fatal(f"Data file {path} is empty")
+
+    header_names: Optional[List[str]] = None
+    fmt = _detect_format(lines[1 if has_header else 0:][:3] or lines[:1])
+    if has_header:
+        sep = {"csv": ",", "tsv": "\t"}.get(fmt, None)
+        header_names = lines[0].split(sep) if sep else lines[0].split()
+        lines = lines[1:]
+
+    if fmt == "libsvm":
+        return _load_libsvm(lines)
+
+    sep = "," if fmt == "csv" else "\t"
+    rows = [ln.split(sep) for ln in lines]
+    ncol = max(len(r) for r in rows)
+    data = np.full((len(rows), ncol), np.nan, dtype=np.float64)
+    for i, r in enumerate(rows):
+        for j, tok in enumerate(r):
+            tok = tok.strip()
+            if tok in ("", "na", "NA", "nan", "NaN", "null", "NULL", "?"):
+                continue
+            data[i, j] = float(tok)
+
+    label_idx = _parse_column_spec(label_column, header_names) \
+        if label_column else 0
+    weight_idx = _parse_column_spec(weight_column, header_names) \
+        if weight_column else -1
+    group_idx = _parse_column_spec(group_column, header_names) \
+        if group_column else -1
+    ignored = set()
+    if ignore_column:
+        for spec in ignore_column.split(","):
+            ignored.add(_parse_column_spec(spec, header_names))
+
+    label = data[:, label_idx]
+    weight = data[:, weight_idx] if weight_idx >= 0 else None
+    group_sizes = None
+    if group_idx >= 0:
+        qid = data[:, group_idx].astype(np.int64)
+        # group sizes from file-order change points (queries are contiguous)
+        change = np.flatnonzero(np.diff(qid)) + 1
+        bounds = np.concatenate([[0], change, [len(qid)]])
+        group_sizes = np.diff(bounds)
+    drop = {label_idx} | ignored
+    if weight_idx >= 0:
+        drop.add(weight_idx)
+    if group_idx >= 0:
+        drop.add(group_idx)
+    feat_cols = [j for j in range(ncol) if j not in drop]
+    X = data[:, feat_cols]
+    names = ([header_names[j] for j in feat_cols] if header_names
+             else [f"Column_{k}" for k in range(len(feat_cols))])
+    log_info(f"Loaded {X.shape[0]} rows x {X.shape[1]} features from {path} "
+             f"({fmt})")
+    return X, label, weight, group_sizes, names
+
+
+def _load_libsvm(lines: List[str]):
+    labels = np.zeros(len(lines), dtype=np.float64)
+    entries: List[List[Tuple[int, float]]] = []
+    max_idx = -1
+    for i, ln in enumerate(lines):
+        toks = ln.split()
+        labels[i] = float(toks[0])
+        row = []
+        for t in toks[1:]:
+            if ":" not in t:
+                continue
+            k, v = t.split(":", 1)
+            idx = int(k)
+            row.append((idx, float(v)))
+            max_idx = max(max_idx, idx)
+        entries.append(row)
+    X = np.zeros((len(lines), max_idx + 1), dtype=np.float64)
+    for i, row in enumerate(entries):
+        for idx, v in row:
+            X[i, idx] = v
+    names = [f"Column_{k}" for k in range(max_idx + 1)]
+    log_info(f"Loaded {X.shape[0]} rows x {X.shape[1]} features (libsvm)")
+    return X, labels, None, None, names

@@ -1,1 +1,19 @@
+"""Boosting models (reference: src/boosting/boosting.cpp CreateBoosting:42)."""
 
+from __future__ import annotations
+
+
+def create_boosting(config, train_set, objective, training_metrics=()):
+    """Factory mirroring Boosting::CreateBoosting
+    (src/boosting/boosting.cpp:42-90): gbdt | dart | rf ('goss' resolves to
+    gbdt + goss sample strategy in config resolution)."""
+    from .dart import DART
+    from .gbdt import GBDT
+    from .rf import RF
+
+    b = config.boosting
+    if b == "dart":
+        return DART(config, train_set, objective, training_metrics)
+    if b == "rf":
+        return RF(config, train_set, objective, training_metrics)
+    return GBDT(config, train_set, objective, training_metrics)

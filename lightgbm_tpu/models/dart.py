@@ -1,0 +1,146 @@
+"""DART boosting (Dropouts meet Multiple Additive Regression Trees).
+
+Faithful re-implementation of the reference DART (src/boosting/dart.hpp:24):
+per iteration a random subset of existing trees is dropped (weighted by tree
+weight unless uniform_drop), their contribution removed from the training
+score before gradients are computed, and after the new tree is trained the
+dropped trees are renormalized by k/(k+1) (or the xgboost_dart_mode variant)
+with train/valid scores patched accordingly (dart.hpp Normalize, the
+three-step shrinkage dance commented at dart.hpp:152-160).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.log import log_debug
+from .gbdt import GBDT
+
+
+class DART(GBDT):
+    """reference: class DART (src/boosting/dart.hpp:24)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._rng_drop = np.random.RandomState(self.config.drop_seed)
+        self.tree_weight_: List[float] = []
+        self.sum_weight_ = 0.0
+        self._drop_index: List[int] = []
+        self._Xb_host = None   # cached host copy of the binned matrix
+
+    def _binned_host(self):
+        if self._Xb_host is None:
+            self._Xb_host = np.asarray(jax.device_get(self.X_t)).T
+        return self._Xb_host
+
+    # -- helpers ------------------------------------------------------
+    def _tree_score_binned(self, tree, Xb_t_host=None):
+        """[K-slice] training-score contribution of `tree` at its CURRENT
+        leaf values (host computation over the binned matrix)."""
+        if Xb_t_host is None:
+            Xb_t_host = self._binned_host()
+        leaf = tree.get_leaf_binned(Xb_t_host, self)
+        return tree.leaf_value[leaf].astype(np.float32)
+
+    def _select_dropping_trees(self) -> None:
+        """dart.hpp DroppingTrees:99-149."""
+        cfg = self.config
+        self._drop_index = []
+        # max_drop <= 0 means unlimited (dart.hpp: size_t cast of max_drop
+        # only caps when positive)
+        drop_cap = cfg.max_drop if cfg.max_drop > 0 else 10**9
+        if self._rng_drop.rand() < cfg.skip_drop:
+            pass
+        elif not cfg.uniform_drop:
+            drop_rate = cfg.drop_rate
+            if self.sum_weight_ > 0:
+                inv_avg = len(self.tree_weight_) / self.sum_weight_
+                if cfg.max_drop > 0:
+                    drop_rate = min(drop_rate,
+                                    cfg.max_drop * inv_avg / self.sum_weight_)
+                for i in range(self.iter):
+                    if self._rng_drop.rand() < \
+                            drop_rate * self.tree_weight_[i] * inv_avg:
+                        self._drop_index.append(i)
+                        if len(self._drop_index) >= drop_cap:
+                            break
+        else:
+            drop_rate = cfg.drop_rate
+            if cfg.max_drop > 0 and self.iter > 0:
+                drop_rate = min(drop_rate, cfg.max_drop / self.iter)
+            for i in range(self.iter):
+                if self._rng_drop.rand() < drop_rate:
+                    self._drop_index.append(i)
+                    if len(self._drop_index) >= drop_cap:
+                        break
+
+        # remove dropped trees from the training score
+        K = self.num_tree_per_iteration
+        Xb = self._binned_host()
+        for i in self._drop_index:
+            for k in range(K):
+                tree = self.models[i * K + k]
+                contrib = self._tree_score_binned(tree, Xb)
+                self.scores = self.scores.at[k].add(-jnp.asarray(contrib))
+        k_drop = len(self._drop_index)
+        if not self.config.xgboost_dart_mode:
+            self.shrinkage_rate = self.config.learning_rate / (1.0 + k_drop)
+        else:
+            if k_drop == 0:
+                self.shrinkage_rate = self.config.learning_rate
+            else:
+                self.shrinkage_rate = self.config.learning_rate / (
+                    self.config.learning_rate + k_drop)
+
+    def _normalize(self) -> None:
+        """dart.hpp Normalize:161-199."""
+        cfg = self.config
+        k = float(len(self._drop_index))
+        if k == 0:
+            return
+        K = self.num_tree_per_iteration
+        Xb = self._binned_host()
+        for i in self._drop_index:
+            for kk in range(K):
+                tree = self.models[i * K + kk]
+                w_contrib = self._tree_score_binned(tree, Xb)  # weight w
+                if not cfg.xgboost_dart_mode:
+                    factor = k / (k + 1.0)
+                else:
+                    factor = k / (k + cfg.learning_rate)
+                # valid: had +w, target w*factor
+                for vi, ds in enumerate(self.valid_sets):
+                    leaf_v = tree.get_leaf_binned(ds.X_binned, self)
+                    contrib_v = tree.leaf_value[leaf_v].astype(np.float32)
+                    self._valid_scores[vi] = self._valid_scores[vi].at[kk].add(
+                        jnp.asarray(contrib_v * (factor - 1.0)))
+                # train: currently 0 (dropped), target w*factor
+                self.scores = self.scores.at[kk].add(
+                    jnp.asarray(w_contrib * factor))
+                tree.shrink(factor)
+            if not cfg.uniform_drop:
+                if not cfg.xgboost_dart_mode:
+                    self.sum_weight_ -= self.tree_weight_[i] / (k + 1.0)
+                    self.tree_weight_[i] *= k / (k + 1.0)
+                else:
+                    self.sum_weight_ -= self.tree_weight_[i] / (
+                        k + cfg.learning_rate)
+                    self.tree_weight_[i] *= k / (k + cfg.learning_rate)
+
+    # -- overrides ----------------------------------------------------
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        self._select_dropping_trees()
+        if self._drop_index:
+            log_debug(f"DART: dropped {len(self._drop_index)} trees")
+        ret = super().train_one_iter(grad, hess)
+        if ret:
+            return ret
+        self._normalize()
+        if not self.config.uniform_drop:
+            self.tree_weight_.append(self.shrinkage_rate)
+            self.sum_weight_ += self.shrinkage_rate
+        return False

@@ -131,6 +131,12 @@ class GBDT:
             path_smooth=cfg.path_smooth,
             num_bins_padded=self.num_bins_padded,
             rows_per_chunk=cfg.tpu_rows_per_block * 8,
+            has_categorical=bool(ds.feature_is_categorical().any()),
+            max_cat_to_onehot=cfg.max_cat_to_onehot,
+            max_cat_threshold=cfg.max_cat_threshold,
+            cat_l2=cfg.cat_l2,
+            cat_smooth=cfg.cat_smooth,
+            min_data_per_group=float(cfg.min_data_per_group),
         )
 
         K = self.num_tree_per_iteration
@@ -204,11 +210,13 @@ class GBDT:
         @jax.jit
         def valid_update(split_feature, threshold_bin, default_left,
                          left_child, right_child, num_leaves, leaf_value,
-                         Xv_t, vmeta_arrs, scores_k, lr):
+                         Xv_t, vmeta_arrs, scores_k, lr, split_is_cat,
+                         split_cat_bitset):
             vmeta = FeatureMeta(*vmeta_arrs)
             leaf = predict_leaf_binned(split_feature, threshold_bin,
                                        default_left, left_child, right_child,
-                                       num_leaves, Xv_t, vmeta)
+                                       num_leaves, Xv_t, vmeta,
+                                       split_is_cat, split_cat_bitset)
             return scores_k + (leaf_value * lr)[leaf]
 
         self._valid_update = valid_update
@@ -341,7 +349,8 @@ class GBDT:
                         tree_dev.right_child, tree_dev.num_leaves,
                         jnp.asarray(leaf_vals),
                         self._valid_Xt[vi], tuple(self._valid_meta[vi]),
-                        self._valid_scores[vi][k], jnp.float32(1.0)))
+                        self._valid_scores[vi][k], jnp.float32(1.0),
+                        tree_dev.split_is_cat, tree_dev.split_cat_bitset))
             # fold the boost-from-average bias into the first tree
             # (gbdt.cpp:425-427)
             if self.iter == 0 and abs(init_scores[k]) > _KEPS:
@@ -410,20 +419,46 @@ class GBDT:
     # ------------------------------------------------------------------
     def _device_tree_to_host(self, host: Any) -> Tree:
         """Convert pulled DeviceTree arrays into a host Tree with real
-        thresholds and real feature indices."""
+        thresholds and real feature indices. Categorical splits translate
+        the device bin-bitset into the reference's category-value bitsets
+        (cat_boundaries/cat_threshold; split_info.hpp cat_threshold,
+        tree.cpp Tree::Split categorical path)."""
         n = int(host.num_leaves)
         m = max(n - 1, 0)
         sf_inner = np.asarray(host.split_feature[:m], np.int32)
-        thr_bin = np.asarray(host.threshold_bin[:m], np.int32)
+        thr_bin = np.array(host.threshold_bin[:m], np.int32)  # writable copy
         dleft = np.asarray(host.default_left[:m], bool)
+        is_cat = np.asarray(host.split_is_cat[:m], bool)
+        cat_bits_bins = np.asarray(host.split_cat_bitset[:m], np.uint32)
         thr_real = np.zeros(m, dtype=np.float64)
         dtype_arr = np.zeros(m, dtype=np.int8)
+        num_cat = 0
+        cat_boundaries = [0]
+        cat_threshold: List[int] = []
         for i in range(m):
             mp = self.mappers[sf_inner[i]]
-            thr_real[i] = mp.bin_to_value(int(thr_bin[i]))
-            dtype_arr[i] = make_decision_type(
-                mp.bin_type == BIN_TYPE_CATEGORICAL, bool(dleft[i]),
-                mp.missing_type)
+            if is_cat[i]:
+                # bins in the left set -> raw category values -> value bitset
+                bits = cat_bits_bins[i]
+                sel_bins = [b for b in range(min(mp.num_bin, 32 * len(bits)))
+                            if (bits[b >> 5] >> (b & 31)) & 1]
+                cats = [mp.bin_2_categorical[b] for b in sel_bins]
+                max_cat = max(cats) if cats else 0
+                nwords = max_cat // 32 + 1
+                words = np.zeros(nwords, dtype=np.uint32)
+                for v in cats:
+                    words[v // 32] |= np.uint32(1 << (v % 32))
+                thr_real[i] = num_cat          # threshold stores cat_idx
+                thr_bin[i] = num_cat
+                cat_boundaries.append(cat_boundaries[-1] + nwords)
+                cat_threshold.extend(words.tolist())
+                num_cat += 1
+                dtype_arr[i] = make_decision_type(True, False,
+                                                  mp.missing_type)
+            else:
+                thr_real[i] = mp.bin_to_value(int(thr_bin[i]))
+                dtype_arr[i] = make_decision_type(False, bool(dleft[i]),
+                                                  mp.missing_type)
         real_feat = np.asarray(
             [self.real_feature_index[f] for f in sf_inner], np.int32)
         lr = self.shrinkage_rate
@@ -443,8 +478,13 @@ class GBDT:
             internal_weight=np.asarray(host.internal_weight[:m], np.float64),
             internal_count=np.asarray(host.internal_count[:m], np.int64),
             shrinkage=lr,
+            cat_boundaries=np.asarray(cat_boundaries, np.int32),
+            cat_threshold=np.asarray(cat_threshold, np.uint32),
+            num_cat=num_cat,
         )
         t.split_feature_inner = sf_inner  # kept for binned traversal
+        t.split_is_cat = is_cat
+        t.split_cat_bitset_bins = cat_bits_bins
         return t
 
     # ------------------------------------------------------------------

@@ -217,6 +217,14 @@ class Tree:
             dl = (self.decision_type[nd] & _DEFAULT_LEFT_MASK) != 0
             go_left = np.where(is_missing, dl,
                                bins <= self.threshold_in_bin[nd])
+            # categorical: test the training-time bin bitset
+            cat_bits = getattr(self, "split_cat_bitset_bins", None)
+            if cat_bits is not None and len(cat_bits):
+                nd_cat = (self.decision_type[nd] & _CATEGORICAL_MASK) != 0
+                W = cat_bits.shape[1]
+                words = cat_bits[nd, np.minimum(bins >> 5, W - 1)]
+                go_left_cat = ((words >> (bins & 31).astype(np.uint32)) & 1) == 1
+                go_left = np.where(nd_cat, go_left_cat, go_left)
             nxt = np.where(go_left, self.left_child[nd], self.right_child[nd])
             leaf_hit = nxt < 0
             out[idx[leaf_hit]] = ~nxt[leaf_hit]

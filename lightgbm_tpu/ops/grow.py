@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.tree import MISSING_NAN, MISSING_ZERO
+from .categorical import CatConfig, find_best_split_categorical
 from .histogram import build_histogram
 from .split import (NEG_INF, FeatureMeta, SplitHyperParams, SplitResult,
                     find_best_split)
@@ -54,6 +55,13 @@ class GrowConfig(NamedTuple):
     path_smooth: float
     num_bins_padded: int        # B: padded bin axis
     rows_per_chunk: int = 8192
+    # categorical split search (reference: config.h cat_* params)
+    has_categorical: bool = False
+    max_cat_to_onehot: int = 4
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    min_data_per_group: float = 100.0
 
     @property
     def hp(self) -> SplitHyperParams:
@@ -65,6 +73,22 @@ class GrowConfig(NamedTuple):
             max_delta_step=self.max_delta_step,
             min_gain_to_split=self.min_gain_to_split,
             path_smooth=self.path_smooth,
+        )
+
+    @property
+    def cat_words(self) -> int:
+        """W: uint32 words per bin-bitset."""
+        return max((self.num_bins_padded + 31) // 32, 1)
+
+    @property
+    def cat(self) -> CatConfig:
+        return CatConfig(
+            max_cat_to_onehot=self.max_cat_to_onehot,
+            max_cat_threshold=self.max_cat_threshold,
+            cat_l2=self.cat_l2,
+            cat_smooth=self.cat_smooth,
+            min_data_per_group=self.min_data_per_group,
+            num_bitset_words=self.cat_words,
         )
 
 
@@ -84,6 +108,8 @@ class DeviceTree(NamedTuple):
     leaf_weight: jnp.ndarray       # [L] f32
     leaf_count: jnp.ndarray        # [L] i32
     split_parent_leaf: jnp.ndarray  # [M] i32: which leaf each split divided
+    split_is_cat: jnp.ndarray      # [M] bool: categorical (bitset) split
+    split_cat_bitset: jnp.ndarray  # [M, W] u32: left-set over bins
 
 
 class _LoopState(NamedTuple):
@@ -96,6 +122,8 @@ class _LoopState(NamedTuple):
     leaf_sum_g: jnp.ndarray        # [L] f32
     leaf_sum_h: jnp.ndarray        # [L] f32
     best: SplitResult              # cached best split per leaf, [L] fields
+    best_is_cat: jnp.ndarray       # [L] bool
+    best_bitset: jnp.ndarray       # [L, W] u32
     done: jnp.ndarray              # bool scalar
 
 
@@ -158,6 +186,23 @@ def grow_tree(
         hist6 = psum(hist6)
         return hist6[..., :3], hist6[..., 3:]
 
+    W = cfg.cat_words
+
+    def search(hist, sum_g, sum_h, count, out):
+        """Best split over numerical + categorical features
+        (FindBestThreshold dispatch, feature_histogram.hpp:166-178)."""
+        num = find_best_split(hist, sum_g, sum_h, count, out, meta, hp,
+                              feature_mask)
+        if not cfg.has_categorical:
+            return num, jnp.zeros((), bool), jnp.zeros((W,), jnp.uint32)
+        catr, bitset = find_best_split_categorical(
+            hist, sum_g, sum_h, count, out, meta, hp, cfg.cat, feature_mask)
+        use_cat = catr.gain > num.gain
+        merged = SplitResult(*[
+            jnp.where(use_cat, cv, nv) for cv, nv in zip(catr, num)])
+        return merged, use_cat, jnp.where(use_cat, bitset,
+                                          jnp.zeros((W,), jnp.uint32))
+
     # ---- root (BeforeTrain: serial_tree_learner.cpp:292-342)
     root_g = psum(jnp.sum(g))
     root_h = psum(jnp.sum(h))
@@ -169,8 +214,8 @@ def grow_tree(
     in_root = in_bag
     vals0 = jnp.stack([g, h, in_root], axis=1)
     hist_root = psum(build_histogram(X_t, vals0, B, cfg.rows_per_chunk))
-    root_split = find_best_split(hist_root, root_g, root_h, root_c, root_out,
-                                 meta, hp, feature_mask)
+    root_split, root_is_cat, root_bitset = search(
+        hist_root, root_g, root_h, root_c, root_out)
     root_split = root_split._replace(
         gain=jnp.where(max_depth >= 1, root_split.gain, NEG_INF))
 
@@ -190,6 +235,8 @@ def grow_tree(
         leaf_count=jnp.zeros((L,), jnp.int32).at[0].set(
             root_c.astype(jnp.int32)),
         split_parent_leaf=jnp.zeros((M,), jnp.int32),
+        split_is_cat=jnp.zeros((M,), bool),
+        split_cat_bitset=jnp.zeros((M, W), jnp.uint32),
     )
     cache = _set_cache(_empty_split_cache(L), 0, root_split, True)
     state = _LoopState(
@@ -202,6 +249,8 @@ def grow_tree(
         leaf_sum_g=jnp.zeros((L,), jnp.float32).at[0].set(root_g),
         leaf_sum_h=jnp.zeros((L,), jnp.float32).at[0].set(root_h),
         best=cache,
+        best_is_cat=jnp.zeros((L,), bool).at[0].set(root_is_cat),
+        best_bitset=jnp.zeros((L, W), jnp.uint32).at[0].set(root_bitset),
         done=jnp.asarray(False),
     )
 
@@ -211,6 +260,8 @@ def grow_tree(
         t = st.tree
         p = jnp.argmax(st.best.gain).astype(jnp.int32)
         bs = SplitResult(*[a[p] for a in st.best])
+        bs_is_cat = st.best_is_cat[p]
+        bs_bitset = st.best_bitset[p]                         # [W]
         valid = (bs.gain > 0.0) & ~st.done
         new_leaf = (s + 1).astype(jnp.int32)
 
@@ -229,6 +280,9 @@ def grow_tree(
             internal_weight=rec(t.internal_weight, st.leaf_sum_h[p]),
             internal_count=rec(t.internal_count, t.leaf_count[p]),
             split_parent_leaf=rec(t.split_parent_leaf, p),
+            split_is_cat=rec(t.split_is_cat, bs_is_cat),
+            split_cat_bitset=t.split_cat_bitset.at[s].set(
+                jnp.where(valid, bs_bitset, t.split_cat_bitset[s])),
             num_leaves=t.num_leaves + valid.astype(jnp.int32),
         )
         # -- fix the pointer that used to reference leaf p
@@ -250,7 +304,12 @@ def grow_tree(
                       & (col == meta.default_bin[bs.feature])) | \
                      ((mt == MISSING_NAN)
                       & (col == meta.num_bins[bs.feature] - 1))
-        go_left = jnp.where(is_missing, bs.default_left, col <= bs.threshold)
+        go_left_num = jnp.where(is_missing, bs.default_left,
+                                col <= bs.threshold)
+        # categorical: bitset membership (Tree::CategoricalDecision analog)
+        words = bs_bitset[jnp.clip(col >> 5, 0, W - 1)]       # [N] u32
+        go_left_cat = ((words >> (col & 31).astype(jnp.uint32)) & 1) == 1
+        go_left = jnp.where(bs_is_cat, go_left_cat, go_left_num)
         in_p = st.leaf_of_row == p
         leaf_of_row = jnp.where(valid & in_p & ~go_left, new_leaf,
                                 st.leaf_of_row)
@@ -289,33 +348,42 @@ def grow_tree(
         # -- histograms + split search for both children
         def compute_children(_):
             hist_l, hist_r = hist_for_children(p, new_leaf, leaf_of_row)
-            can_l = depth_child < max_depth
-            can_r = depth_child < max_depth
-            sl = find_best_split(hist_l, bs.left_sum_g, bs.left_sum_h,
-                                 bs.left_count, bs.left_output, meta, hp,
-                                 feature_mask)
-            sr = find_best_split(hist_r, bs.right_sum_g, bs.right_sum_h,
-                                 bs.right_count, bs.right_output, meta, hp,
-                                 feature_mask)
-            sl = sl._replace(gain=jnp.where(can_l, sl.gain, NEG_INF))
-            sr = sr._replace(gain=jnp.where(can_r, sr.gain, NEG_INF))
-            return sl, sr
+            can = depth_child < max_depth
+            sl, cl, bl = search(hist_l, bs.left_sum_g, bs.left_sum_h,
+                                bs.left_count, bs.left_output)
+            sr, cr, br = search(hist_r, bs.right_sum_g, bs.right_sum_h,
+                                bs.right_count, bs.right_output)
+            sl = sl._replace(gain=jnp.where(can, sl.gain, NEG_INF))
+            sr = sr._replace(gain=jnp.where(can, sr.gain, NEG_INF))
+            return sl, cl, bl, sr, cr, br
 
         def skip_children(_):
             zero = _empty_split_cache(1)
             one = SplitResult(*[a[0] for a in zero])
-            return one, one
+            nocat = jnp.zeros((), bool)
+            nobits = jnp.zeros((W,), jnp.uint32)
+            return one, nocat, nobits, one, nocat, nobits
 
-        sl, sr = jax.lax.cond(valid, compute_children, skip_children, None)
+        sl, cl, bl, sr, cr, br = jax.lax.cond(
+            valid, compute_children, skip_children, None)
         best = _set_cache(st.best, p, sl, valid)
         best = _set_cache(best, new_leaf, sr, valid)
+        best_is_cat = st.best_is_cat.at[p].set(
+            jnp.where(valid, cl, st.best_is_cat[p]))
+        best_is_cat = best_is_cat.at[new_leaf].set(
+            jnp.where(valid, cr, best_is_cat[new_leaf]))
+        best_bitset = st.best_bitset.at[p].set(
+            jnp.where(valid, bl, st.best_bitset[p]))
+        best_bitset = best_bitset.at[new_leaf].set(
+            jnp.where(valid, br, best_bitset[new_leaf]))
 
         return _LoopState(
             tree=t, leaf_of_row=leaf_of_row,
             leaf_parent_node=leaf_parent_node, leaf_is_left=leaf_is_left,
             leaf_depth=leaf_depth, leaf_output=leaf_output,
             leaf_sum_g=leaf_sum_g, leaf_sum_h=leaf_sum_h,
-            best=best, done=st.done | ~valid)
+            best=best, best_is_cat=best_is_cat, best_bitset=best_bitset,
+            done=st.done | ~valid)
 
     if L > 1:
         state = jax.lax.fori_loop(0, L - 1, split_once, state)

@@ -25,6 +25,8 @@ def predict_leaf_binned(
     num_leaves: jnp.ndarray,      # i32 scalar
     X_t: jnp.ndarray,             # [F, N] binned feature-major
     meta: FeatureMeta,
+    split_is_cat: jnp.ndarray = None,     # [M] bool (optional)
+    split_cat_bitset: jnp.ndarray = None,  # [M, W] u32 (optional)
 ) -> jnp.ndarray:
     """Leaf index per row ([N] int32)."""
     N = X_t.shape[1]
@@ -47,6 +49,13 @@ def predict_leaf_binned(
             | ((mt == MISSING_NAN) & (bin_v == meta.num_bins[f] - 1))
         go_left = jnp.where(is_missing, default_left[nd],
                             bin_v <= threshold_bin[nd])
+        if split_is_cat is not None:
+            W = split_cat_bitset.shape[1]
+            words = jnp.take_along_axis(
+                split_cat_bitset[nd], jnp.clip(bin_v >> 5, 0, W - 1)[:, None],
+                axis=1)[:, 0]
+            go_left_cat = ((words >> (bin_v & 31).astype(jnp.uint32)) & 1) == 1
+            go_left = jnp.where(split_is_cat[nd], go_left_cat, go_left)
         nxt = jnp.where(go_left, left_child[nd], right_child[nd])
         return jnp.where(node >= 0, nxt, node)
 

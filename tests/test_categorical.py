@@ -1,0 +1,71 @@
+"""Categorical split tests (reference behavior: test_engine.py categorical
+cases — one-hot and sorted many-vs-many splits, save/load round-trip)."""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _cat_problem(n=2000, seed=3, num_cats=12):
+    """Label depends on membership of a category subset — only a many-vs-many
+    categorical split can separate it well."""
+    rng = np.random.RandomState(seed)
+    cat = rng.randint(0, num_cats, size=n)
+    x_num = rng.normal(size=n)
+    good = {1, 3, 4, 8, 11}
+    y = (np.isin(cat, list(good)) ^ (x_num > 1.5)).astype(np.float32)
+    X = np.column_stack([cat.astype(np.float64), x_num])
+    return X, y
+
+
+def test_categorical_split_learns_subset():
+    X, y = _cat_problem()
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "min_data_in_leaf": 20, "verbose": -1,
+                     "min_data_per_group": 10},
+                    ds, num_boost_round=20)
+    pred = bst.predict(X)
+    acc = np.mean((pred > 0.5) == (y > 0.5))
+    assert acc > 0.9, acc
+    # at least one tree must actually contain a categorical split
+    assert any(t.num_cat > 0 for t in bst._gbdt.models)
+
+
+def test_categorical_save_load_roundtrip():
+    X, y = _cat_problem(n=1200, seed=9)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({"objective": "binary", "num_leaves": 8, "verbose": -1,
+                     "min_data_per_group": 10},
+                    ds, num_boost_round=8)
+    p1 = bst.predict(X)
+    bst2 = lgb.Booster(model_str=bst.model_to_string())
+    p2 = bst2.predict(X)
+    np.testing.assert_allclose(p1, p2, rtol=1e-6, atol=1e-7)
+
+
+def test_onehot_categorical_small_cardinality():
+    # num_bins <= max_cat_to_onehot triggers the one-hot path
+    rng = np.random.RandomState(1)
+    n = 800
+    cat = rng.randint(0, 3, size=n)
+    y = (cat == 2).astype(np.float32)
+    X = np.column_stack([cat.astype(np.float64), rng.normal(size=n)])
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({"objective": "binary", "num_leaves": 4, "verbose": -1,
+                     "max_cat_to_onehot": 4, "min_data_in_leaf": 5},
+                    ds, num_boost_round=10)
+    pred = bst.predict(X)
+    assert np.mean((pred > 0.5) == (y > 0.5)) > 0.99
+
+
+def test_unseen_category_goes_right():
+    X, y = _cat_problem(n=1000, seed=5, num_cats=6)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({"objective": "binary", "num_leaves": 8, "verbose": -1,
+                     "min_data_per_group": 10},
+                    ds, num_boost_round=5)
+    X_unseen = X.copy()
+    X_unseen[:5, 0] = 99  # category never seen in training
+    out = bst.predict(X_unseen)
+    assert np.all(np.isfinite(out[:5]))

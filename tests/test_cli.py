@@ -1,0 +1,85 @@
+"""CLI driver tests (reference: tests/python_package_test/test_consistency.py
+runs examples/*/train.conf through the CLI binary)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.cli import main as cli_main
+
+
+@pytest.fixture
+def workdir(tmp_path):
+    rng = np.random.RandomState(0)
+    n = 500
+    X = rng.normal(size=(n, 6))
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    rows = np.column_stack([y, X])
+    train_path = tmp_path / "train.tsv"
+    np.savetxt(train_path, rows, delimiter="\t", fmt="%.8g")
+    conf = tmp_path / "train.conf"
+    conf.write_text(
+        f"task = train\n"
+        f"objective = binary\n"
+        f"data = {train_path}\n"
+        f"num_iterations = 10   # comment\n"
+        f"num_leaves = 7\n"
+        f"verbosity = -1\n"
+        f"output_model = {tmp_path / 'model.txt'}\n")
+    return tmp_path, train_path, conf
+
+
+def test_cli_train_and_predict(workdir):
+    tmp_path, train_path, conf = workdir
+    assert cli_main([f"config={conf}"]) == 0
+    model_path = tmp_path / "model.txt"
+    assert model_path.exists()
+
+    out_path = tmp_path / "preds.tsv"
+    assert cli_main([
+        "task=predict", f"data={train_path}", f"input_model={model_path}",
+        f"output_result={out_path}", "verbosity=-1"]) == 0
+    preds = np.loadtxt(out_path)
+    assert preds.shape == (500,)
+    y = np.loadtxt(train_path, delimiter="\t")[:, 0]
+    assert np.mean((preds > 0.5) == (y > 0.5)) > 0.9
+
+
+def test_cli_arg_overrides_config(workdir):
+    tmp_path, train_path, conf = workdir
+    out_model = tmp_path / "model2.txt"
+    assert cli_main([f"config={conf}", f"output_model={out_model}",
+                     "num_trees=3"]) == 0
+    bst = lgb.Booster(model_file=str(out_model))
+    assert bst.num_trees() == 3
+
+
+def test_cli_refit_and_convert(workdir):
+    tmp_path, train_path, conf = workdir
+    cli_main([f"config={conf}"])
+    model_path = tmp_path / "model.txt"
+    out_model = tmp_path / "refit.txt"
+    assert cli_main([
+        "task=refit", f"data={train_path}", f"input_model={model_path}",
+        f"output_model={out_model}", "verbosity=-1"]) == 0
+    assert out_model.exists()
+
+    cpp_out = tmp_path / "model.cpp"
+    assert cli_main([
+        "task=convert_model", f"input_model={model_path}",
+        f"convert_model={cpp_out}", "verbosity=-1"]) == 0
+    src = cpp_out.read_text()
+    assert "double Predict(const double* arr)" in src
+    assert "PredictTree0" in src
+
+
+def test_libsvm_loader(tmp_path):
+    path = tmp_path / "data.svm"
+    path.write_text("1 0:0.5 2:1.5\n0 1:2.0\n1 0:1.0 1:1.0 2:0.25\n")
+    from lightgbm_tpu.data.loader import load_text_file
+    X, y, w, g, names = load_text_file(str(path))
+    assert X.shape == (3, 3)
+    np.testing.assert_array_equal(y, [1, 0, 1])
+    assert X[0, 0] == 0.5 and X[1, 1] == 2.0 and X[2, 2] == 0.25

@@ -1,0 +1,48 @@
+"""Plotting tests (reference: tests/python_package_test/test_plotting.py)."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(400, 5))
+    y = X[:, 0] * 2 + X[:, 1]
+    record = {}
+    ds = lgb.Dataset(X, y)
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "num_leaves": 7, "metric": "l2"},
+                    ds, num_boost_round=10, valid_sets=[ds],
+                    valid_names=["train"],
+                    callbacks=[lgb.record_evaluation(record)])
+    return bst, record
+
+
+def test_plot_importance(fitted):
+    bst, _ = fitted
+    ax = lgb.plot_importance(bst)
+    assert len(ax.patches) > 0
+    ax2 = lgb.plot_importance(bst, importance_type="gain", max_num_features=2)
+    assert len(ax2.patches) <= 2
+
+
+def test_plot_metric(fitted):
+    bst, record = fitted
+    ax = lgb.plot_metric(record)
+    assert len(ax.lines) == 1
+
+
+def test_create_tree_digraph(fitted):
+    bst, _ = fitted
+    g = lgb.create_tree_digraph(bst, tree_index=0)
+    src = g.source
+    assert "split0" in src and "leaf" in src
+    with pytest.raises(IndexError):
+        lgb.create_tree_digraph(bst, tree_index=99)

@@ -1,0 +1,88 @@
+"""sklearn estimator API tests (reference: tests/python_package_test/
+test_sklearn.py core cases)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import LGBMClassifier, LGBMRanker, LGBMRegressor
+
+
+def test_regressor_fit_predict():
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(800, 10))
+    y = X[:, 0] * 3 - X[:, 1] + 0.1 * rng.normal(size=800)
+    reg = LGBMRegressor(n_estimators=30, num_leaves=15, min_child_samples=5)
+    reg.fit(X, y)
+    pred = reg.predict(X)
+    corr = np.corrcoef(pred, y)[0, 1]
+    assert corr > 0.95
+    assert reg.n_features_in_ == 10
+    assert reg.feature_importances_.shape == (10,)
+    assert reg.feature_importances_[0] > 0
+
+
+def test_binary_classifier():
+    rng = np.random.RandomState(1)
+    X = rng.normal(size=(600, 8))
+    y_raw = np.where(X[:, 0] + X[:, 1] > 0, "pos", "neg")
+    clf = LGBMClassifier(n_estimators=20, num_leaves=15)
+    clf.fit(X, y_raw)
+    assert set(clf.classes_) == {"neg", "pos"}
+    assert clf.n_classes_ == 2
+    proba = clf.predict_proba(X)
+    assert proba.shape == (600, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
+    pred = clf.predict(X)
+    assert np.mean(pred == y_raw) > 0.9
+
+
+def test_multiclass_classifier():
+    rng = np.random.RandomState(2)
+    X = rng.normal(size=(900, 6))
+    y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+    clf = LGBMClassifier(n_estimators=15, num_leaves=7)
+    clf.fit(X, y)
+    assert clf.n_classes_ == 3
+    proba = clf.predict_proba(X)
+    assert proba.shape == (900, 3)
+    assert np.mean(clf.predict(X) == y) > 0.8
+
+
+def test_eval_set_and_early_stopping():
+    rng = np.random.RandomState(3)
+    X = rng.normal(size=(1000, 10))
+    y = X[:, 0] + 0.3 * rng.normal(size=1000)
+    reg = LGBMRegressor(n_estimators=200, num_leaves=7, learning_rate=0.2)
+    reg.fit(X[:700], y[:700], eval_set=[(X[700:], y[700:])],
+            callbacks=[lgb.early_stopping(5, verbose=False)])
+    assert reg.best_iteration_ > 0
+    assert reg.best_iteration_ <= 200
+    assert "valid_0" in reg.evals_result_
+
+
+def test_ranker_requires_group():
+    rng = np.random.RandomState(4)
+    X = rng.normal(size=(100, 5))
+    y = rng.randint(0, 3, size=100)
+    with pytest.raises(ValueError):
+        LGBMRanker().fit(X, y)
+    rk = LGBMRanker(n_estimators=5, num_leaves=7, min_child_samples=3)
+    rk.fit(X, y, group=[25, 25, 25, 25])
+    assert rk.predict(X).shape == (100,)
+
+
+def test_get_set_params_clone():
+    reg = LGBMRegressor(n_estimators=10, num_leaves=5, extra_param=1)
+    params = reg.get_params()
+    assert params["n_estimators"] == 10
+    assert params["extra_param"] == 1
+    reg.set_params(n_estimators=20)
+    assert reg.n_estimators == 20
+    from sklearn.base import clone
+    reg2 = clone(LGBMRegressor(n_estimators=7))
+    assert reg2.n_estimators == 7
+    # full base params must survive clone (get_params introspects __init__)
+    reg3 = clone(LGBMRegressor(reg_alpha=1.5, min_child_samples=5))
+    assert reg3.reg_alpha == 1.5
+    assert reg3.min_child_samples == 5
