@@ -426,6 +426,10 @@ class Booster:
         g = new_booster._gbdt
         if g.objective is None:
             raise ValueError("Cannot refit a model without an objective")
+        # restore training regularization (the model string only carries the
+        # objective); refit-time params override
+        cfg = resolve_params({**self.params, **kwargs})
+        g.config = cfg
         label = np.asarray(label, np.float32).reshape(-1)
         K = g.num_tree_per_iteration
         N = data.shape[0]
@@ -436,35 +440,41 @@ class Booster:
         md.set_label(label)
         g.objective.init(md, N)
         scores = np.zeros((K, N), dtype=np.float64)
-        cfg = g.config
-        for mi, tree in enumerate(g.models):
-            k = mi % K
-            import jax.numpy as jnp
+        import jax.numpy as jnp
+        total_iters = len(g.models) // max(K, 1)
+        for it in range(total_iters):
+            # gradients ONCE per iteration, before any class's score update
+            # (reference: GBDT::RefitTree calls Boosting() per iteration)
             if g.objective.runs_on_host:
-                grad, hess = g.objective.get_gradients_numpy(
+                grads, hesss = g.objective.get_gradients_numpy(
                     scores.reshape(-1).astype(np.float64))
-                grad = grad.reshape(K, N)[k]
-                hess = hess.reshape(K, N)[k]
+                grads = grads.reshape(K, N)
+                hesss = hesss.reshape(K, N)
             else:
                 gg, hh = g.objective.get_gradients(
-                    jnp.asarray(scores[k], jnp.float32)
-                    if K == 1 else jnp.asarray(scores, jnp.float32),
+                    jnp.asarray(scores[0] if K == 1 else scores,
+                                jnp.float32),
                     jnp.asarray(label), None)
-                grad = np.asarray(gg).reshape(K, -1)[k] \
-                    if np.asarray(gg).ndim > 1 else np.asarray(gg)
-                hess = np.asarray(hh).reshape(K, -1)[k] \
-                    if np.asarray(hh).ndim > 1 else np.asarray(hh)
-            leaf = leaf_preds[:, mi]
-            nl = tree.num_leaves
-            sum_g = np.bincount(leaf, weights=grad, minlength=nl)
-            sum_h = np.bincount(leaf, weights=hess, minlength=nl)
-            reg = np.abs(sum_g) - cfg.lambda_l1
-            new_val = -np.sign(sum_g) * np.maximum(reg, 0.0) / (
-                sum_h + cfg.lambda_l2 + 1e-15)
-            new_val *= tree.shrinkage
-            tree.leaf_value = (decay_rate * tree.leaf_value
-                               + (1.0 - decay_rate) * new_val[:nl])
-            scores[k] += tree.leaf_value[leaf]
+                grads = np.asarray(gg).reshape(K, N) \
+                    if np.asarray(gg).ndim > 1 \
+                    else np.asarray(gg).reshape(1, N)
+                hesss = np.asarray(hh).reshape(K, N) \
+                    if np.asarray(hh).ndim > 1 \
+                    else np.asarray(hh).reshape(1, N)
+            for k in range(K):
+                mi = it * K + k
+                tree = g.models[mi]
+                leaf = leaf_preds[:, mi]
+                nl = tree.num_leaves
+                sum_g = np.bincount(leaf, weights=grads[k], minlength=nl)
+                sum_h = np.bincount(leaf, weights=hesss[k], minlength=nl)
+                reg = np.abs(sum_g) - cfg.lambda_l1
+                new_val = -np.sign(sum_g) * np.maximum(reg, 0.0) / (
+                    sum_h + cfg.lambda_l2 + 1e-15)
+                new_val *= tree.shrinkage
+                tree.leaf_value = (decay_rate * tree.leaf_value
+                                   + (1.0 - decay_rate) * new_val[:nl])
+                scores[k] += tree.leaf_value[leaf]
         return new_booster
 
     def dump_model_to_cpp(self) -> str:

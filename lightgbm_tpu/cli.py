@@ -86,8 +86,10 @@ def run_predict(params: Dict[str, Any], cfg) -> None:
     if not cfg.input_model:
         log_fatal("task=predict requires input_model")
     booster = Booster(model_file=cfg.input_model)
+    # drop the same non-feature columns as training, or features shift
     X, _, _, _, _ = load_text_file(
         cfg.data, has_header=cfg.header, label_column=cfg.label_column,
+        weight_column=cfg.weight_column, group_column=cfg.group_column,
         ignore_column=cfg.ignore_column)
     pred = booster.predict(
         X, raw_score=cfg.predict_raw_score,
@@ -107,8 +109,9 @@ def run_refit(params: Dict[str, Any], cfg) -> None:
     booster = Booster(model_file=cfg.input_model)
     X, y, _, _, _ = load_text_file(
         cfg.data, has_header=cfg.header, label_column=cfg.label_column,
+        weight_column=cfg.weight_column, group_column=cfg.group_column,
         ignore_column=cfg.ignore_column)
-    booster = booster.refit(X, y, decay_rate=cfg.refit_decay_rate)
+    booster = booster.refit(X, y, decay_rate=cfg.refit_decay_rate, **params)
     booster.save_model(cfg.output_model)
     log_info(f"Finished refit; model saved to {cfg.output_model}")
 

@@ -151,7 +151,11 @@ class Config:
     same XLA graphs on the host platform.
     """
 
-    # -- core
+    # -- core (tpu_grower: "auto" picks the compacted per-leaf grower when
+    # the per-leaf histogram cache fits in memory, else the masked full-scan
+    # grower; "compact"/"masked" force one — the TPU analog of the
+    # reference's force_col_wise/force_row_wise histogram-mode switch)
+    tpu_grower: str = "auto"
     task: str = "train"
     data: str = ""
     valid: Union[str, List[str]] = ""

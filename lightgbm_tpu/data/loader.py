@@ -110,7 +110,10 @@ def load_text_file(path: str, has_header: bool = False,
 
 
 def _load_libsvm(lines: List[str]):
+    """LibSVM sparse format, incl. ranking `qid:` tokens
+    (reference: parser.hpp SVM parser + qid handling)."""
     labels = np.zeros(len(lines), dtype=np.float64)
+    qids: List[int] = []
     entries: List[List[Tuple[int, float]]] = []
     max_idx = -1
     for i, ln in enumerate(lines):
@@ -121,6 +124,9 @@ def _load_libsvm(lines: List[str]):
             if ":" not in t:
                 continue
             k, v = t.split(":", 1)
+            if k == "qid":
+                qids.append(int(v))
+                continue
             idx = int(k)
             row.append((idx, float(v)))
             max_idx = max(max_idx, idx)
@@ -129,6 +135,12 @@ def _load_libsvm(lines: List[str]):
     for i, row in enumerate(entries):
         for idx, v in row:
             X[i, idx] = v
+    group_sizes = None
+    if len(qids) == len(lines) and qids:
+        q = np.asarray(qids)
+        change = np.flatnonzero(np.diff(q)) + 1
+        bounds = np.concatenate([[0], change, [len(q)]])
+        group_sizes = np.diff(bounds)
     names = [f"Column_{k}" for k in range(max_idx + 1)]
     log_info(f"Loaded {X.shape[0]} rows x {X.shape[1]} features (libsvm)")
-    return X, labels, None, None, names
+    return X, labels, None, group_sizes, names

@@ -31,11 +31,23 @@ class DART(GBDT):
         self.sum_weight_ = 0.0
         self._drop_index: List[int] = []
         self._Xb_host = None   # cached host copy of the binned matrix
+        self._leaf_cache = {}  # model idx -> (train leaves, [valid leaves])
 
     def _binned_host(self):
         if self._Xb_host is None:
             self._Xb_host = np.asarray(jax.device_get(self.X_t)).T
         return self._Xb_host
+
+    def _tree_leaves(self, mi: int):
+        """Cached leaf assignments (immutable once a tree is grown)."""
+        cached = self._leaf_cache.get(mi)
+        if cached is None or len(cached[1]) != len(self.valid_sets):
+            tree = self.models[mi]
+            lt = tree.get_leaf_binned(self._binned_host(), self)
+            lv = [tree.get_leaf_binned(ds.X_binned, self)
+                  for ds in self.valid_sets]
+            self._leaf_cache[mi] = (lt, lv)
+        return self._leaf_cache[mi]
 
     # -- helpers ------------------------------------------------------
     def _tree_score_binned(self, tree, Xb_t_host=None):

@@ -139,6 +139,20 @@ class GBDT:
             min_data_per_group=float(cfg.min_data_per_group),
         )
 
+        # grower selection: the compact path needs the per-leaf histogram
+        # cache [L, F, B, 3] resident (the reference bounds the same
+        # structure with histogram_pool_size, serial_tree_learner.cpp:40)
+        cache_bytes = (cfg.num_leaves * len(ds.mappers)
+                       * self.num_bins_padded * 3 * 4)
+        pool_limit = (cfg.histogram_pool_size * 1024 * 1024
+                      if cfg.histogram_pool_size > 0 else 512 * 1024 * 1024)
+        if cfg.tpu_grower == "compact":
+            self.use_compact = True
+        elif cfg.tpu_grower == "masked":
+            self.use_compact = False
+        else:
+            self.use_compact = cache_bytes <= pool_limit
+
         K = self.num_tree_per_iteration
         N = self.num_data
         md = ds.metadata
@@ -191,14 +205,19 @@ class GBDT:
         cfg_static = self.grow_cfg
         meta = self.meta
 
+        if self.use_compact:
+            from ..ops.grow_fast import grow_tree_fast as grow_fn
+        else:
+            grow_fn = grow_tree
+
         if self.use_dist:
             from ..parallel import build_data_parallel_train_fn
             self._train_tree = build_data_parallel_train_fn(
-                self.mesh, meta, cfg_static)
+                self.mesh, meta, cfg_static, grow_fn=grow_fn)
         else:
             @jax.jit
             def train_tree(X_t, grad, hess, in_bag, scores_k, lr, feat_mask):
-                tree, leaf_of_row = grow_tree(
+                tree, leaf_of_row = grow_fn(
                     X_t, grad, hess, in_bag, meta, cfg_static,
                     feature_mask=feat_mask)
                 leaf_shrunk = tree.leaf_value * lr

@@ -179,7 +179,10 @@ class Tree:
         go_left = np.zeros(fval.shape[0], dtype=bool)
         valid = ~np.isnan(fval) & (fval >= 0)
         iv = np.where(valid, fval, 0).astype(np.int64)
-        cat_idx = self.threshold_in_bin[nodes].astype(np.int64)
+        # called for ALL nodes and masked by the caller: numerical nodes'
+        # threshold_in_bin is a bin index, not a cat_idx — clip it
+        cat_idx = np.clip(self.threshold_in_bin[nodes].astype(np.int64),
+                          0, max(self.num_cat - 1, 0))
         starts = self.cat_boundaries[cat_idx]
         sizes = self.cat_boundaries[cat_idx + 1] - starts
         in_range = valid & (iv < sizes.astype(np.int64) * 32)

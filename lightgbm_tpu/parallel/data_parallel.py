@@ -37,7 +37,8 @@ def pad_rows_to(n: int, num_shards: int, multiple: int = 8) -> int:
 
 def build_data_parallel_train_fn(mesh: jax.sharding.Mesh,
                                  meta: FeatureMeta,
-                                 cfg: GrowConfig):
+                                 cfg: GrowConfig,
+                                 grow_fn=grow_tree):
     """Returns jit(train_step) with the same signature as the serial
     `_train_tree` in models/gbdt.py:
 
@@ -45,12 +46,13 @@ def build_data_parallel_train_fn(mesh: jax.sharding.Mesh,
         -> (DeviceTree replicated, leaf_of_row [N], new_scores [N])
 
     N must be divisible by the mesh's data-axis size (pad with in_bag == 0
-    rows via `pad_rows_to`).
+    rows via `pad_rows_to`). `grow_fn` is either the masked grower
+    (ops/grow.py) or the compacted one (ops/grow_fast.py).
     """
     dist = DistContext(DATA_AXIS)
 
     def step(X_t, grad, hess, in_bag, scores_k, lr, feat_mask):
-        tree, leaf_of_row = grow_tree(
+        tree, leaf_of_row = grow_fn(
             X_t, grad, hess, in_bag, meta, cfg,
             feature_mask=feat_mask, dist=dist)
         new_scores = scores_k + (tree.leaf_value * lr)[leaf_of_row]

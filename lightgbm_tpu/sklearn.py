@@ -145,6 +145,14 @@ class LGBMModel(BaseEstimator):
         self._n_features = X.shape[1]
         y_tr = self._process_label(y, params)
 
+        # class_weight -> per-sample weights (reference: sklearn.py
+        # _LGBMComputeSampleWeight in LGBMClassifier.fit)
+        if self.class_weight is not None:
+            from sklearn.utils.class_weight import compute_sample_weight
+            cw = compute_sample_weight(self.class_weight, y)
+            sample_weight = cw if sample_weight is None \
+                else np.asarray(sample_weight, np.float64) * cw
+
         train_set = Dataset(X, label=y_tr, weight=sample_weight,
                             init_score=init_score, group=group,
                             feature_name=feature_name,
