@@ -111,7 +111,19 @@ def run_refit(params: Dict[str, Any], cfg) -> None:
         cfg.data, has_header=cfg.header, label_column=cfg.label_column,
         weight_column=cfg.weight_column, group_column=cfg.group_column,
         ignore_column=cfg.ignore_column)
-    booster = booster.refit(X, y, decay_rate=cfg.refit_decay_rate, **params)
+    # strip IO/task keys: `data` collides with refit's positional arg, the
+    # rest are CLI plumbing that must not persist as model hyperparameters
+    _cli_only = {
+        "task", "data", "valid", "decay_rate", "refit_decay_rate",
+        "input_model", "output_model", "snapshot_freq", "header",
+        "label_column", "weight_column", "group_column", "ignore_column",
+        "save_binary", "start_iteration_predict", "num_iteration_predict",
+        "predict_raw_score", "predict_leaf_index", "predict_contrib",
+        "output_result", "convert_model",
+    }
+    refit_params = {k: v for k, v in params.items() if k not in _cli_only}
+    booster = booster.refit(X, y, decay_rate=cfg.refit_decay_rate,
+                            **refit_params)
     booster.save_model(cfg.output_model)
     log_info(f"Finished refit; model saved to {cfg.output_model}")
 
