@@ -56,7 +56,7 @@ def _gain_and_outputs(lg, lh, lc, rg, rh, rc, hp, parent_output):
 
 
 def find_best_split_categorical(
-    hist: jnp.ndarray,          # [F, B, 3] float32
+    hist: jnp.ndarray,          # [3, F, B] float32 (channel-major)
     parent_sum_g: jnp.ndarray,
     parent_sum_h: jnp.ndarray,
     parent_count: jnp.ndarray,
@@ -71,14 +71,14 @@ def find_best_split_categorical(
     Returns (SplitResult, bin_bitset [W] uint32). gain == -inf when no
     categorical split is valid.
     """
-    F, B, _ = hist.shape
+    _, F, B = hist.shape
     W = cat.num_bitset_words
     bins = jnp.arange(B, dtype=jnp.int32)[None, :]          # [1, B]
     nb = meta.num_bins[:, None]                              # [F, 1]
 
-    g = hist[..., 0]
-    h = hist[..., 1]
-    c = jnp.round(hist[..., 2])
+    g = hist[0]
+    h = hist[1]
+    c = jnp.round(hist[2])
 
     is_cat = meta.is_categorical
     if feature_mask is not None:

@@ -181,10 +181,10 @@ def grow_tree(
         in_l = (leaf_of_row == leaf_l).astype(jnp.float32) * in_bag
         in_r = (leaf_of_row == leaf_r).astype(jnp.float32) * in_bag
         vals = jnp.stack([g * in_l, h * in_l, in_l,
-                          g * in_r, h * in_r, in_r], axis=1)  # [N, 6]
+                          g * in_r, h * in_r, in_r], axis=0)  # [6, N]
         hist6 = build_histogram(X_t, vals, B, cfg.rows_per_chunk)
         hist6 = psum(hist6)
-        return hist6[..., :3], hist6[..., 3:]
+        return hist6[:3], hist6[3:]
 
     W = cfg.cat_words
 
@@ -212,7 +212,7 @@ def grow_tree(
         / (root_h + hp.lambda_l2), jnp.float32)
 
     in_root = in_bag
-    vals0 = jnp.stack([g, h, in_root], axis=1)
+    vals0 = jnp.stack([g, h, in_root], axis=0)
     hist_root = psum(build_histogram(X_t, vals0, B, cfg.rows_per_chunk))
     root_split, root_is_cat, root_bitset = search(
         hist_root, root_g, root_h, root_c, root_out)

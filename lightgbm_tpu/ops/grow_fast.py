@@ -73,7 +73,7 @@ class _FastState(NamedTuple):
     leaf_output: jnp.ndarray       # [L] f32
     leaf_sum_g: jnp.ndarray        # [L] f32
     leaf_sum_h: jnp.ndarray        # [L] f32
-    hist_cache: jnp.ndarray        # [L, F, B, 3] f32 (global hists)
+    hist_cache: jnp.ndarray        # [L, 3, F, B] f32 (global hists)
     best: SplitResult
     best_is_cat: jnp.ndarray
     best_bitset: jnp.ndarray
@@ -126,7 +126,7 @@ def grow_tree_fast(
         -jnp.sign(root_g) * jnp.maximum(jnp.abs(root_g) - hp.lambda_l1, 0.0)
         / (root_h + hp.lambda_l2), jnp.float32)
 
-    vals0 = jnp.stack([g, h, in_bag], axis=1)
+    vals0 = jnp.stack([g, h, in_bag], axis=0)
     hist_root = psum(build_histogram(X_t, vals0, B, cfg.rows_per_chunk))
     root_split, root_is_cat, root_bitset = search(
         hist_root, root_g, root_h, root_c, root_out)
@@ -152,7 +152,7 @@ def grow_tree_fast(
         split_is_cat=jnp.zeros((M,), bool),
         split_cat_bitset=jnp.zeros((M, W), jnp.uint32),
     )
-    hist_cache = jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(hist_root)
+    hist_cache = jnp.zeros((L, 3, F, B), jnp.float32).at[0].set(hist_root)
     state = _FastState(
         tree=tree,
         order=jnp.arange(N, dtype=jnp.int32),
@@ -176,7 +176,7 @@ def grow_tree_fast(
     def make_branch(S: int):
         """Bucket-S branch: partition leaf p's rows + smaller-child hist.
 
-        Returns (order [N], n_left_local i32, hist_small [F, B, 3]).
+        Returns (order [N], n_left_local i32, hist_small [3, F, B]).
         """
 
         def branch(args):
@@ -222,7 +222,7 @@ def grow_tree_fast(
             Xg = jnp.take(X_t, idx, axis=1)                          # [F, S]
             vals = jnp.stack([grad[idx].astype(jnp.float32) * m,
                               hess[idx].astype(jnp.float32) * m,
-                              m], axis=1)
+                              m], axis=0)
             hist_small = build_histogram(Xg, vals, B, cfg.rows_per_chunk)
             return order, n_left, hist_small
 

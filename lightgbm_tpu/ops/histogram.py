@@ -7,16 +7,22 @@ gather-accumulate: hist[bin[r, f]] += (grad[r], hess[r]).
 TPUs have no scatter-add in the VPU/MXU path, so the TPU-native formulation is
 a one-hot contraction on the MXU: for each row-chunk,
 
-    hist[f, b, c] += sum_r  onehot(bin[f, r] == b) * vals[r, c]
+    hist[c, f, b] += sum_r  onehot(bin[f, r] == b) * vals[c, r]
 
-which XLA lowers to batched [B, R] @ [R, C] matmuls per feature block. The
-VMEM blocking mirrors the CUDA kernel's shared-memory per-block histogram with
-the flush/atomicAdd replaced by the contraction itself. A fused Pallas variant
-lives in `histogram_pallas.py`; this module is the portable XLA lowering used
-on CPU meshes and as a fallback.
+which XLA lowers to batched [C, R] @ [R, B] matmuls per feature. The fused
+Pallas variants live in `histogram_pallas.py`; this module holds the portable
+XLA lowerings (CPU test meshes, fallback) and the dispatch.
 
-Layout: the binned matrix is feature-major [F, N] so that single-feature
-column reads (partition updates, ops/grow.py) are contiguous slices.
+Layouts (all channel-major — the bin axis rides the 128-lane dimension):
+  X_t   [F, N]      int8/uint8, feature-major
+  vals  [C, N]      f32 (gradient / hessian / count channels)
+  hist  [C, F, B]   f32  (single leaf)   or   [K, C, F, B] (wave of K slots)
+
+`build_histogram_slots` is the wave kernel: `slot` assigns each row to one of
+K histogram sets (or none, slot outside [0, K)); one pass over the data
+produces all K children's histograms — the per-feature one-hot work is shared
+across the whole wave, which is the key TPU-side economy over re-scanning
+per split (see ops/grow_wave.py).
 """
 
 from __future__ import annotations
@@ -30,10 +36,9 @@ import jax.numpy as jnp
 from ..utils import round_up as _round_up
 
 
-def _use_pallas(X_binned_t: jnp.ndarray, vals: jnp.ndarray,
-                num_bins: int) -> bool:
+def _use_pallas(X_binned_t: jnp.ndarray, num_bins: int) -> bool:
     """Fused Pallas kernel on real TPU backends; XLA lowering elsewhere
-    (CPU test meshes, >8-bit bins, >8 channels).
+    (CPU test meshes, >8-bit bins).
 
     The env-var kill switch is read at TRACE time: it must be set before the
     first training step of the process (the jit cache is not keyed on it).
@@ -43,9 +48,6 @@ def _use_pallas(X_binned_t: jnp.ndarray, vals: jnp.ndarray,
         return False
     if num_bins > 256 or X_binned_t.dtype not in (jnp.uint8, jnp.int8):
         return False
-    from .histogram_pallas import C_PAD
-    if vals.shape[1] > C_PAD:
-        return False
     try:
         return jax.default_backend() == "tpu"
     except RuntimeError:
@@ -54,63 +56,102 @@ def _use_pallas(X_binned_t: jnp.ndarray, vals: jnp.ndarray,
 
 def build_histogram(
     X_binned_t: jnp.ndarray,   # [F, N] uint8/uint16/int32 (feature-major)
-    vals: jnp.ndarray,         # [N, C] float32 (grad, hess, count, ... masked)
+    vals: jnp.ndarray,         # [C, N] float32 (already masked for leaf/bag)
     num_bins: int,             # B: padded bin-axis size (static)
     rows_per_chunk: int = 8192,
     dtype=jnp.float32,
 ) -> jnp.ndarray:
-    """Dense one-hot-matmul histogram: returns [F, B, C] float32.
+    """Dense one-hot-matmul histogram: returns [C, F, B] float32.
 
     `vals` must already be masked (zeroed) for rows outside the target leaf /
-    bag. Rows are processed in chunks under `lax.scan` so the materialized
-    one-hot block stays in VMEM-sized pieces.
+    bag.
     """
-    if _use_pallas(X_binned_t, vals, num_bins):
+    if _use_pallas(X_binned_t, num_bins):
         from .histogram_pallas import build_histogram_pallas
         return build_histogram_pallas(X_binned_t, vals, num_bins)
     return _build_histogram_xla(X_binned_t, vals, num_bins, rows_per_chunk,
                                 dtype)
 
 
+def build_histogram_slots(
+    X_binned_t: jnp.ndarray,   # [F, N] uint8/int8 (feature-major)
+    vals: jnp.ndarray,         # [C, N] float32 (bag-masked, NOT slot-masked)
+    slot: jnp.ndarray,         # [N] int32: wave slot per row; outside [0, K)
+                               #     = row contributes nowhere
+    num_slots: int,            # K (static)
+    num_bins: int,             # B (static)
+    rows_per_chunk: int = 8192,
+) -> jnp.ndarray:
+    """Wave histogram: returns [K, C, F, B] float32."""
+    if _use_pallas(X_binned_t, num_bins):
+        from .histogram_pallas import build_histogram_slots_pallas
+        return build_histogram_slots_pallas(X_binned_t, vals, slot,
+                                            num_slots, num_bins)
+    return _build_histogram_slots_xla(X_binned_t, vals, slot, num_slots,
+                                      num_bins, rows_per_chunk)
+
+
 def _build_histogram_xla(X_binned_t, vals, num_bins, rows_per_chunk=8192,
                          dtype=jnp.float32):
     """Portable XLA lowering (also the pinned reference in kernel tests)."""
     F, N = X_binned_t.shape
-    C = vals.shape[1]
+    C = vals.shape[0]
     B = num_bins
     chunk = min(rows_per_chunk, _round_up(N, 128))
     Np = _round_up(N, chunk)
     if Np != N:
         X_binned_t = jnp.pad(X_binned_t, ((0, 0), (0, Np - N)))
-        vals = jnp.pad(vals, ((0, Np - N), (0, 0)))
+        vals = jnp.pad(vals, ((0, 0), (0, Np - N)))
     n_chunks = Np // chunk
 
     Xc = X_binned_t.reshape(F, n_chunks, chunk).transpose(1, 0, 2)  # [nc,F,R]
-    Vc = vals.reshape(n_chunks, chunk, C).astype(dtype)
+    Vc = vals.reshape(C, n_chunks, chunk).transpose(1, 0, 2)        # [nc,C,R]
     iota = jnp.arange(B, dtype=jnp.int32)
 
     def body(hist, xs):
-        xb, vb = xs                                   # [F, R], [R, C]
+        xb, vb = xs                                   # [F, R], [C, R]
         onehot = (xb[:, :, None].astype(jnp.int32) == iota[None, None, :]
                   ).astype(dtype)                     # [F, R, B]
-        part = jnp.einsum("frb,rc->fbc", onehot, vb,
+        part = jnp.einsum("frb,cr->cfb", onehot, vb.astype(dtype),
                           preferred_element_type=jnp.float32)
         return hist + part, None
 
-    hist0 = jnp.zeros((F, B, C), dtype=jnp.float32)
+    hist0 = jnp.zeros((C, F, B), dtype=jnp.float32)
     hist, _ = jax.lax.scan(body, hist0, (Xc, Vc))
     return hist
 
 
-def build_histogram_1d(
-    bins: jnp.ndarray,       # [N] int
-    vals: jnp.ndarray,       # [N, C] float32
-    num_bins: int,
-    dtype=jnp.float32,
-) -> jnp.ndarray:
-    """[B, C] histogram over a single bin vector (used by categorical and
-    quantile helpers)."""
-    iota = jnp.arange(num_bins, dtype=jnp.int32)
-    onehot = (bins[:, None].astype(jnp.int32) == iota[None, :]).astype(dtype)
-    return jnp.einsum("rb,rc->bc", onehot, vals.astype(dtype),
-                      preferred_element_type=jnp.float32)
+def _build_histogram_slots_xla(X_binned_t, vals, slot, num_slots, num_bins,
+                               rows_per_chunk=8192):
+    """Portable XLA wave lowering: one-hot over the combined (slot, bin)
+    index — the pinned reference for the Pallas wave kernel tests."""
+    F, N = X_binned_t.shape
+    C = vals.shape[0]
+    K, B = num_slots, num_bins
+    chunk = min(rows_per_chunk, _round_up(N, 128))
+    Np = _round_up(N, chunk)
+    if Np != N:
+        X_binned_t = jnp.pad(X_binned_t, ((0, 0), (0, Np - N)))
+        vals = jnp.pad(vals, ((0, 0), (0, Np - N)))
+        slot = jnp.pad(slot, (0, Np - N), constant_values=-1)
+    n_chunks = Np // chunk
+
+    Xc = X_binned_t.reshape(F, n_chunks, chunk).transpose(1, 0, 2)
+    Vc = vals.reshape(C, n_chunks, chunk).transpose(1, 0, 2)
+    Sc = slot.reshape(n_chunks, chunk)
+    iota_b = jnp.arange(B, dtype=jnp.int32)
+    iota_k = jnp.arange(K, dtype=jnp.int32)
+
+    def body(hist, xs):
+        xb, vb, sb = xs                               # [F,R], [C,R], [R]
+        oh_bin = (xb[:, :, None].astype(jnp.int32) == iota_b[None, None, :]
+                  ).astype(jnp.float32)               # [F, R, B]
+        oh_slot = (sb[None, :] == iota_k[:, None]).astype(jnp.float32)
+        w = oh_slot[:, None, :] * vb[None, :, :]      # [K, C, R]
+        part = jnp.einsum("frb,kcr->kcfb", oh_bin, w,
+                          preferred_element_type=jnp.float32)
+        return hist + part, None
+
+    hist0 = jnp.zeros((K, C, F, B), jnp.float32)
+    hist, _ = jax.lax.scan(body, hist0, (Xc, Vc, Sc))
+    return hist

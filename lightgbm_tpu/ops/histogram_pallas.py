@@ -1,4 +1,4 @@
-"""Fused Pallas TPU histogram kernel — hot loop #1 of the framework.
+"""Fused Pallas TPU histogram kernels — hot loop #1 of the framework.
 
 TPU-native re-design of the CUDA shared-memory histogram kernel
 (CUDAConstructHistogramDenseKernel, cuda_histogram_constructor.cu:20-72):
@@ -6,20 +6,36 @@ there, each thread block accumulates a per-block histogram in shared memory
 with atomicAdd and flushes to global memory. TPUs have no atomics; the
 equivalent play is:
 
-  * VMEM is the "shared memory": the output block [F_blk, C, B] stays
-    resident in VMEM while the grid walks row-chunks (the revisit-accumulate
-    pattern replaces the atomic flush),
+  * VMEM is the "shared memory": the output block stays resident in VMEM
+    while the grid walks row-chunks (the revisit-accumulate pattern replaces
+    the atomic flush),
   * the scatter-add over bins becomes an on-the-fly one-hot (iota compare in
     VMEM, never materialized to HBM) contracted against the value channels on
     the MXU: hist[c, b] += vals[c, r] * (bins[r] == b).
 
-This is the key difference from the portable XLA lowering in histogram.py,
-which materializes the [F, R, B] one-hot through HBM and is bandwidth-bound.
+Two kernels:
+
+  build_histogram_pallas        one histogram set      -> [C, F, B]
+  build_histogram_slots_pallas  K sets in one pass     -> [K, C, F, B]
+
+The slots ("wave") kernel is the performance centerpiece. Cost model per
+row-feature: the per-feature one-hot compare (the VPU-bound part, ~2*LO
+element-ops) is paid ONCE per pass regardless of K, while each slot only
+adds rows to the W matrix fed to the MXU. Growing K children per pass
+(ops/grow_wave.py) therefore divides the dominant VPU cost by the wave size
+— this replaces the CUDA design's atomicAdd-on-index-list economy, which
+has no TPU equivalent (gathers cost as much as full rescans here).
 
 Layouts chosen for the TPU tiling rules (last dim = 128 lanes):
   X_t   [F_pad, N_pad]  int8   (F padded to 32 — int8 sublane tile)
-  vals  [C_pad, N_pad]  f32    (channels-major so N is the lane dim)
-  out   [F_pad, C_pad, B] f32  (B is the lane dim, padded to 128)
+  vals  [C, N_pad]      f32    (channels-major so N is the lane dim)
+  out   [(K,) C, F_pad, B] f32 (B is the lane dim)
+
+The MXU contraction runs in bfloat16 with float32 accumulation: one-hot
+entries are exact in bf16, gradient/hessian values round to 8 mantissa bits
+before the exact f32 accumulation (the same single-precision-histogram
+trade the reference's GPU learner makes, docs/GPU-Performance.rst; the
+count channel stays exact since its values are 0/1).
 """
 
 from __future__ import annotations
@@ -35,24 +51,31 @@ from ..utils import round_up as _round_up
 
 F_BLK = 32          # int8 sublane tile
 N_BLK = 2048        # rows per grid step
-C_PAD = 8           # f32 sublane tile (max histogram channels)
 
 
-def _hist_kernel(x_ref, v_ref, out_ref):
+def _compute_dims(num_bins: int):
+    """B padded to a lane-friendly width; LO = one-hot compare width,
+    HB = number of 128-lane sub-blocks of the bin axis."""
+    if num_bins <= 32:
+        B = 32
+    elif num_bins <= 64:
+        B = 64
+    elif num_bins <= 128:
+        B = 128
+    else:
+        B = 256
+    LO = min(B, 128)
+    HB = B // LO
+    return B, LO, HB
+
+
+def _slots_kernel(x_ref, v_ref, s_ref, out_ref, *, K, C, B, LO, HB):
     """Grid (F_blocks, N_blocks); N varies fastest so out_ref stays resident.
 
-    x_ref  [F_BLK, R] int8
-    v_ref  [C_PAD, R] f32 (rows beyond N zeroed by caller padding)
-    out_ref[F_BLK, C_PAD, B] f32
-
-    Two-level bin decomposition: bin = hi * 128 + lo. The expensive lane-wide
-    compare runs only over the 128 `lo` values; the `hi` part becomes H = B/128
-    masked copies of the value channels that ride the same MXU contraction:
-
-        part[(hi, c), lo] = sum_r vals[c, r] * [bin_hi(r) == hi] * [bin_lo(r) == lo]
-
-    VPU work per feature drops from ~2B x R (compare + convert) to
-    ~(128 + H + H*C) x R, a ~3x cut at B = 256.
+    x_ref  [F_BLK, R] int8      binned features
+    v_ref  [C, R]     f32       value channels (bag-masked)
+    s_ref  [1, R]     int32     slot id per row; outside [0, K) = inactive
+    out_ref[K, C, F_BLK, B] f32
     """
     n = pl.program_id(1)
 
@@ -60,78 +83,108 @@ def _hist_kernel(x_ref, v_ref, out_ref):
     def _():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    B = out_ref.shape[2]
-    H = B // 128
     R = v_ref.shape[1]
-    C = v_ref.shape[0]
+    sl = s_ref[0, :]                                       # [R] i32
     vals = v_ref[...]                                      # [C, R]
-    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (128, R), 0)
 
-    for f in range(F_BLK):
+    # W [K*C, R]: slot-masked value channels — shared across all features
+    w_rows = []
+    for k in range(K):
+        mk = (sl == k).astype(jnp.float32)
+        w_rows.append(vals * mk[None, :])
+    W = jnp.concatenate(w_rows, axis=0).astype(jnp.bfloat16)   # [K*C, R]
+
+    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (LO, R), 0)
+
+    for f in range(x_ref.shape[0]):
         # int8 storage sign-extends bins >= 128; mask back to unsigned
         bins_f = x_ref[f, :].astype(jnp.int32) & 0xFF      # [R]
-        lo = bins_f & 127
-        hi = bins_f >> 7
-        oh_lo = (lo[None, :] == lo_iota).astype(jnp.float32)     # [128, R]
-        if H == 1:
-            w = vals
+        lo = bins_f & (LO - 1)
+        oh_lo = (lo[None, :] == lo_iota).astype(jnp.bfloat16)   # [LO, R]
+        if HB == 1:
+            # one MXU contraction per feature: [K*C, R] x [LO, R]^T
+            part = jax.lax.dot_general(
+                W, oh_lo, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)        # [K*C, LO]
+            out_ref[:, :, f, :] += part.reshape(K, C, B)
         else:
-            w = jnp.concatenate(
-                [vals * (hi[None, :] == hh).astype(jnp.float32)
-                 for hh in range(H)], axis=0)              # [H*C, R]
-        # MXU: [H*C, R] x [128, R]^T -> [H*C, 128]
-        part = jax.lax.dot_general(
-            w, oh_lo,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        out_ref[f, :, :] += part.reshape(H, C, 128).transpose(1, 0, 2) \
-            .reshape(C, B)
+            hi = bins_f >> 7
+            for hb in range(HB):
+                Whb = W * (hi[None, :] == hb).astype(jnp.bfloat16)
+                part = jax.lax.dot_general(
+                    Whb, oh_lo, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                out_ref[:, :, f, hb * LO:(hb + 1) * LO] += \
+                    part.reshape(K, C, LO)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_slots", "num_bins", "interpret"))
+def build_histogram_slots_pallas(
+    X_binned_t: jnp.ndarray,   # [F, N] int8/uint8 (feature-major)
+    vals: jnp.ndarray,         # [C, N] f32 (bag-masked)
+    slot: jnp.ndarray,         # [N] int32
+    num_slots: int,
+    num_bins: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Wave histogram on TPU: returns [K, C, F, num_bins] float32."""
+    F, N = X_binned_t.shape
+    C = vals.shape[0]
+    K = num_slots
+    B, LO, HB = _compute_dims(num_bins)
+    Fp = _round_up(F, F_BLK)
+    n_blk = N_BLK if N >= N_BLK else max(_round_up(N, 256), 256)
+    Np = _round_up(N, n_blk)
+
+    X = X_binned_t.astype(jnp.int8)
+    if Fp != F or Np != N:
+        X = jnp.pad(X, ((0, Fp - F), (0, Np - N)))
+    v = vals.astype(jnp.float32)
+    s = slot.astype(jnp.int32)
+    if Np != N:
+        v = jnp.pad(v, ((0, 0), (0, Np - N)))
+        s = jnp.pad(s, (0, Np - N), constant_values=-1)
+
+    grid = (Fp // F_BLK, Np // n_blk)
+    kernel = functools.partial(_slots_kernel, K=K, C=C, B=B, LO=LO, HB=HB)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((F_BLK, n_blk), lambda f, n: (f, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, n_blk), lambda f, n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n_blk), lambda f, n: (0, n),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((K, C, F_BLK, B), lambda f, n: (0, 0, f, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((K, C, Fp, B), jnp.float32),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * K * C * Fp * Np * B,
+            bytes_accessed=Fp * Np + (C * 4 + 4) * Np + K * C * Fp * B * 4,
+            transcendentals=0,
+        ),
+    )(X, v, s[None, :])
+
+    return out[:, :, :F, :num_bins]
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "interpret"))
 def build_histogram_pallas(
     X_binned_t: jnp.ndarray,   # [F, N] int8/uint8 (feature-major)
-    vals: jnp.ndarray,         # [N, C] f32 (already masked for leaf/bag)
-    num_bins: int,             # static; padded internally to 128
+    vals: jnp.ndarray,         # [C, N] f32 (already masked for leaf/bag)
+    num_bins: int,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Dense binned histogram on TPU: returns [F, num_bins, C] float32."""
-    F, N = X_binned_t.shape
-    C = vals.shape[1]
-    B = max(_round_up(num_bins, 128), 128)
-    Fp = _round_up(F, F_BLK)
-    # small inputs (compact-grower leaf buckets) use a tighter row block to
-    # avoid padding everything up to the full N_BLK
-    n_blk = N_BLK if N >= N_BLK else _round_up(N, 256)
-    Np = _round_up(N, n_blk)
-    Cp = C_PAD
+    """Single-set histogram on TPU: returns [C, F, num_bins] float32.
 
-    X = X_binned_t.astype(jnp.int8)
-    if Fp != F or Np != N:
-        X = jnp.pad(X, ((0, Fp - F), (0, Np - N)))
-    # channels-major [C_pad, N_pad]; padded rows carry val 0 => no effect
-    v_t = jnp.zeros((Cp, Np), jnp.float32).at[:C, :N].set(
-        vals.astype(jnp.float32).T)
-
-    grid = (Fp // F_BLK, Np // n_blk)
-    out = pl.pallas_call(
-        _hist_kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((F_BLK, n_blk), lambda f, n: (f, n),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((Cp, n_blk), lambda f, n: (0, n),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((F_BLK, Cp, B), lambda f, n: (f, 0, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((Fp, Cp, B), jnp.float32),
-        interpret=interpret,
-        cost_estimate=pl.CostEstimate(
-            flops=2 * Fp * Np * B * Cp,
-            bytes_accessed=Fp * Np + Cp * Np * 4 + Fp * Cp * B * 4,
-            transcendentals=0,
-        ),
-    )(X, v_t)
-
-    return jnp.transpose(out[:F, :C, :], (0, 2, 1))[:, :num_bins, :]
+    Lowered as the K=1 wave kernel with every row active."""
+    N = X_binned_t.shape[1]
+    slot = jnp.zeros((N,), jnp.int32)
+    out = build_histogram_slots_pallas(X_binned_t, vals, slot, 1, num_bins,
+                                       interpret=interpret)
+    return out[0]

@@ -8,6 +8,12 @@ right->left per feature, both direction scans for ALL features are expressed
 as cumulative sums over the [F, B] histogram with masking, and the best
 (feature, threshold, direction) is a single argmax.
 
+Histogram layout is channel-major [3, F, B] (channels: sum_grad, sum_hess,
+count) so that every intermediate is a clean [F, B] tile with the bin axis on
+the 128-wide lane dimension — cumsums and compares vectorize perfectly. The
+previous [F, B, 3] layout put 3 on the minor axis, which the TPU pads to a
+full lane tile (42x wasted VPU work).
+
 Gain math is the exact reference formula set (ThresholdL1 /
 CalculateSplittedLeafOutput / GetLeafGainGivenOutput,
 feature_histogram.hpp:712-829) including lambda_l1/l2, max_delta_step and
@@ -101,7 +107,7 @@ def leaf_gain(sum_g, sum_h, hp: SplitHyperParams, num_data, parent_output):
 
 
 def find_best_split(
-    hist: jnp.ndarray,          # [F, B, 3] float32: (sum_g, sum_h, count)
+    hist: jnp.ndarray,          # [3, F, B] float32: (sum_g, sum_h, count)
     parent_sum_g: jnp.ndarray,  # scalar
     parent_sum_h: jnp.ndarray,
     parent_count: jnp.ndarray,
@@ -116,7 +122,7 @@ def find_best_split(
     features are handled by `find_best_split_categorical` (ops/categorical.py)
     and masked out here.
     """
-    F, B, _ = hist.shape
+    _, F, B = hist.shape
     bins = jnp.arange(B, dtype=jnp.int32)[None, :]          # [1, B]
     nb = meta.num_bins[:, None]                              # [F, 1]
 
@@ -127,24 +133,23 @@ def find_best_split(
         jnp.where(meta.missing_type == MISSING_ZERO, meta.default_bin, -1))
     excl = (bins == missing_bin[:, None]) | ~valid_bin       # [F, B]
 
-    acc = jnp.where(excl[:, :, None], 0.0, hist)             # [F, B, 3]
-    cum = jnp.cumsum(acc, axis=1)                            # [F, B, 3]
-    acc_tot = cum[:, -1:, :]                                 # [F, 1, 3]
+    acc = jnp.where(excl[None, :, :], 0.0, hist)             # [3, F, B]
+    cum = jnp.cumsum(acc, axis=-1)                           # [3, F, B]
+    acc_tot = cum[:, :, -1:]                                 # [3, F, 1]
 
     parent = jnp.stack([parent_sum_g, parent_sum_h,
                         parent_count.astype(jnp.float32)])   # [3]
-    miss = parent[None, None, :] - acc_tot                   # [F, 1, 3]
+    miss = parent[:, None, None] - acc_tot                   # [3, F, 1]
 
     # threshold t: left = bins <= t.
     # dir 0 (forward scan): left = cum[t];       missing right
     # dir 1 (reverse scan): left = cum[t]+miss;  missing left
-    left_f = cum
-    left_r = cum + miss
-    left = jnp.stack([left_f, left_r], axis=0)               # [2, F, B, 3]
-    right = parent[None, None, None, :] - left
+    # stacked as [3, 2, F, B]
+    left = jnp.stack([cum, cum + miss], axis=1)
+    right = parent[:, None, None, None] - left
 
-    lg, lh, lc = left[..., 0], left[..., 1], jnp.round(left[..., 2])
-    rg, rh, rc = right[..., 0], right[..., 1], jnp.round(right[..., 2])
+    lg, lh, lc = left[0], left[1], jnp.round(left[2])        # [2, F, B]
+    rg, rh, rc = right[0], right[1], jnp.round(right[2])
 
     # threshold validity (scan ranges, feature_histogram.hpp:860-944):
     # t in [0, num_bin-2]; for the reverse scan of a NaN-missing feature the
@@ -189,8 +194,9 @@ def find_best_split(
     f = (best // B) % F
     t = best % B
 
-    def pick(a):
-        return a[d, f, t]
+    # one fused gather for all per-split stats instead of 8 tiny ones
+    stats = jnp.stack([lg, lh, lc, rg, rh, rc, lout, rout])  # [8, 2, F, B]
+    picked = stats.reshape(8, -1)[:, best]
 
     return SplitResult(
         gain=jnp.where(jnp.isfinite(best_gain),
@@ -198,7 +204,7 @@ def find_best_split(
         feature=f.astype(jnp.int32),
         threshold=t.astype(jnp.int32),
         default_left=(d == 1),
-        left_sum_g=pick(lg), left_sum_h=pick(lh), left_count=pick(lc),
-        right_sum_g=pick(rg), right_sum_h=pick(rh), right_count=pick(rc),
-        left_output=pick(lout), right_output=pick(rout),
+        left_sum_g=picked[0], left_sum_h=picked[1], left_count=picked[2],
+        right_sum_g=picked[3], right_sum_h=picked[4], right_count=picked[5],
+        left_output=picked[6], right_output=picked[7],
     )

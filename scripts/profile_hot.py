@@ -1,0 +1,102 @@
+"""Profile the training hot path piece by piece on the real chip.
+
+Usage: python scripts/profile_hot.py [rows] [cols] [leaves]
+"""
+import sys
+import time
+
+import numpy as np
+
+rows = int(sys.argv[1]) if len(sys.argv) > 1 else 500_000
+cols = int(sys.argv[2]) if len(sys.argv) > 2 else 28
+leaves = int(sys.argv[3]) if len(sys.argv) > 3 else 255
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.histogram_pallas import build_histogram_pallas
+from lightgbm_tpu.ops.histogram import _build_histogram_xla
+from lightgbm_tpu.ops.grow import GrowConfig
+from lightgbm_tpu.ops.grow_fast import grow_tree_fast
+from lightgbm_tpu.ops.split import FeatureMeta, find_best_split
+
+rng = np.random.RandomState(0)
+B = 256
+X_np = rng.randint(0, 255, size=(cols, rows)).astype(np.uint8)
+Xt = jnp.asarray(X_np.astype(np.int8))
+g = jnp.asarray(rng.normal(size=rows).astype(np.float32))
+h = jnp.asarray(np.abs(rng.normal(size=rows)).astype(np.float32))
+ones = jnp.ones((rows,), jnp.float32)
+vals = jnp.stack([g, h, ones], axis=0)
+
+
+def timeit(name, fn, *args, n=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:45s} {dt*1e3:10.2f} ms")
+    return dt
+
+
+timeit("pallas hist full-N (root)", lambda: build_histogram_pallas(Xt, vals, B))
+
+# gather of S columns (the per-split compaction)
+for S in (4096, 65536, 262144):
+    idx = jnp.asarray(rng.permutation(rows)[:S].astype(np.int32))
+
+    @jax.jit
+    def gather(idx):
+        return jnp.take(Xt, idx, axis=1)
+
+    timeit(f"jnp.take gather S={S}", gather, idx)
+
+    @jax.jit
+    def hist_bucket(idx):
+        Xg = jnp.take(Xt, idx, axis=1)
+        v = jnp.stack([g[idx], h[idx], ones[idx]], axis=0)
+        return build_histogram_pallas(Xg, v, B)
+
+    timeit(f"gather+hist bucket S={S}", hist_bucket, idx)
+
+# split search on a [F, B, 3] histogram
+meta = FeatureMeta(
+    num_bins=jnp.full((cols,), B, jnp.int32),
+    missing_type=jnp.zeros((cols,), jnp.int32),
+    default_bin=jnp.zeros((cols,), jnp.int32),
+    is_categorical=jnp.zeros((cols,), bool),
+)
+cfg = GrowConfig(
+    num_leaves=leaves, max_depth=-1, min_data_in_leaf=20.0,
+    min_sum_hessian_in_leaf=1e-3, lambda_l1=0.0, lambda_l2=0.0,
+    max_delta_step=0.0, min_gain_to_split=0.0, path_smooth=0.0,
+    num_bins_padded=B, rows_per_chunk=16384,
+)
+hist = build_histogram_pallas(Xt, vals, B)
+sum_g = jnp.sum(g)
+sum_h = jnp.sum(h)
+cnt = jnp.float32(rows)
+
+
+@jax.jit
+def split_search(hist, sum_g, sum_h, cnt):
+    return find_best_split(hist, sum_g, sum_h, cnt, jnp.float32(0.0),
+                           meta, cfg.hp, None)
+
+
+timeit("find_best_split [3,F,B]", split_search, hist, sum_g, sum_h, cnt)
+
+
+@jax.jit
+def full_tree(Xt, g, h, ones):
+    return grow_tree_fast(Xt, g, h, ones, meta, cfg)
+
+
+t0 = time.perf_counter()
+out = full_tree(Xt, g, h, ones)
+jax.block_until_ready(out)
+print(f"full tree compile+run: {time.perf_counter()-t0:.1f} s")
+timeit(f"full tree grow (L={leaves})", full_tree, Xt, g, h, ones, n=3)

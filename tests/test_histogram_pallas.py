@@ -1,5 +1,11 @@
-"""Pallas histogram kernel vs the portable XLA lowering (interpret mode on
-the CPU test platform; the same kernel compiles for real TPUs)."""
+"""Pallas histogram kernels vs the portable XLA lowering (interpret mode on
+the CPU test platform; the same kernels compile for real TPUs).
+
+The Pallas kernels contract in bfloat16 (f32 accumulation). Exactness tests
+use values on a coarse binary grid (exactly representable in bf16, so the
+products and f32 sums are exact); a separate test bounds the bf16 rounding
+error for continuous values.
+"""
 
 import numpy as np
 import jax.numpy as jnp
@@ -7,8 +13,15 @@ import pytest
 
 # pin the reference to the XLA body: on a TPU backend the public
 # build_histogram would dispatch to the very kernel under test
-from lightgbm_tpu.ops.histogram import _build_histogram_xla as build_histogram
-from lightgbm_tpu.ops.histogram_pallas import build_histogram_pallas
+from lightgbm_tpu.ops.histogram import (_build_histogram_xla,
+                                        _build_histogram_slots_xla)
+from lightgbm_tpu.ops.histogram_pallas import (build_histogram_pallas,
+                                               build_histogram_slots_pallas)
+
+
+def _bf16_exact_vals(rng, C, N):
+    """Values on a 0.25 grid in [-8, 8): exact in bfloat16."""
+    return (rng.randint(-32, 32, size=(C, N)) * 0.25).astype(np.float32)
 
 
 @pytest.mark.parametrize("F,N,C,B,hi", [
@@ -20,25 +33,64 @@ from lightgbm_tpu.ops.histogram_pallas import build_histogram_pallas
 def test_matches_xla_lowering(F, N, C, B, hi):
     rng = np.random.RandomState(F * 1000 + N)
     X = rng.randint(0, hi, size=(F, N)).astype(np.uint8)
-    vals = rng.normal(size=(N, C)).astype(np.float32)
-    ref = build_histogram(jnp.asarray(X), jnp.asarray(vals), B)
+    vals = _bf16_exact_vals(rng, C, N)
+    ref = _build_histogram_xla(jnp.asarray(X), jnp.asarray(vals), B)
     got = build_histogram_pallas(jnp.asarray(X), jnp.asarray(vals), B,
                                  interpret=True)
-    assert got.shape == (F, B, C)
+    assert got.shape == (C, F, B)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
-                               rtol=1e-5, atol=1e-4)
+                               rtol=0, atol=1e-6)
+
+
+@pytest.mark.parametrize("F,N,C,B,K", [
+    (7, 3000, 3, 64, 8),
+    (28, 4096, 3, 256, 16),
+    (3, 500, 3, 32, 4),
+])
+def test_slots_matches_xla_lowering(F, N, C, B, K):
+    rng = np.random.RandomState(F + N + K)
+    X = rng.randint(0, B - 1, size=(F, N)).astype(np.uint8)
+    vals = _bf16_exact_vals(rng, C, N)
+    # slots include inactive rows (slot == -1 and slot == K)
+    slot = rng.randint(-1, K + 1, size=N).astype(np.int32)
+    ref = _build_histogram_slots_xla(jnp.asarray(X), jnp.asarray(vals),
+                                     jnp.asarray(slot), K, B)
+    got = build_histogram_slots_pallas(jnp.asarray(X), jnp.asarray(vals),
+                                       jnp.asarray(slot), K, B,
+                                       interpret=True)
+    assert got.shape == (K, C, F, B)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=0, atol=1e-6)
+
+
+def test_bf16_error_bounded_for_continuous_values():
+    rng = np.random.RandomState(0)
+    F, N, C, B = 4, 8192, 3, 64
+    X = rng.randint(0, B - 1, size=(F, N)).astype(np.uint8)
+    vals = rng.normal(size=(C, N)).astype(np.float32)
+    ref = np.asarray(_build_histogram_xla(jnp.asarray(X), jnp.asarray(vals),
+                                          B))
+    got = np.asarray(build_histogram_pallas(jnp.asarray(X),
+                                            jnp.asarray(vals), B,
+                                            interpret=True))
+    # bf16 rounds each addend to 8 mantissa bits; bound the bin error by
+    # 2^-8 times the sum of absolute addends in that bin
+    abs_ref = np.asarray(_build_histogram_xla(
+        jnp.asarray(X), jnp.asarray(np.abs(vals)), B))
+    err_bound = abs_ref * 2.0 ** -8 + 1e-6
+    assert np.all(np.abs(got - ref) <= err_bound)
 
 
 def test_masked_rows_contribute_nothing():
     rng = np.random.RandomState(0)
     F, N, C, B = 4, 512, 3, 32
     X = rng.randint(0, 31, size=(F, N)).astype(np.uint8)
-    vals = rng.normal(size=(N, C)).astype(np.float32)
+    vals = _bf16_exact_vals(rng, C, N)
     mask = (rng.rand(N) < 0.5).astype(np.float32)
-    vals_masked = vals * mask[:, None]
+    vals_masked = vals * mask[None, :]
     got = build_histogram_pallas(jnp.asarray(X), jnp.asarray(vals_masked), B,
                                  interpret=True)
-    ref = build_histogram(jnp.asarray(X[:, mask > 0]),
-                          jnp.asarray(vals[mask > 0]), B)
+    ref = _build_histogram_xla(jnp.asarray(X[:, mask > 0]),
+                               jnp.asarray(vals[:, mask > 0]), B)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
-                               rtol=1e-5, atol=1e-4)
+                               rtol=0, atol=1e-6)
