@@ -40,13 +40,27 @@ def main() -> None:
                   bagging_freq=0)
     ds = lgb.Dataset(X, label=y)
 
-    # warmup: one full boosting iteration to trigger jit compilation
+    # warmup: one full boosting iteration to trigger jit compilation.
+    # Training dispatches asynchronously; the scalar fetch (device_get)
+    # before/after the timed loop is the real device-completion barrier.
+    import jax
+
+    def barrier(b):
+        jax.device_get(jnp_sum_scores(b))
+
+    import jax.numpy as jnp
+
+    def jnp_sum_scores(b):
+        return jnp.sum(b._gbdt.scores)
+
     booster = lgb.Booster(params=params, train_set=ds)
     booster.update()
+    barrier(booster)
 
     t0 = time.perf_counter()
     for _ in range(iters):
         booster.update()
+    barrier(booster)
     dt = time.perf_counter() - t0
 
     row_iters_per_sec = rows * iters / dt

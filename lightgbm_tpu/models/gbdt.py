@@ -5,10 +5,13 @@ Train:246, UpdateScore:502) + model (de)serialization
 (gbdt_model_text.cpp:321 SaveModelToString, LoadModelFromString).
 
 Device/host split: scores, gradients, the binned matrix and tree growth live
-on device; each grown tree's arrays (a few KB) are pulled back per iteration
-to build the host `Tree` used for model export and raw-data prediction —
-mirroring the CUDA design where only tiny split descriptors cross the
-host<->device boundary (SURVEY.md §3.5).
+on device; grown trees stay on device as `DeviceTree` records and are only
+materialized into host `Tree` objects (for model export / raw-data
+prediction) lazily and in batches — the training loop itself issues NO host
+synchronization, so iterations stream asynchronously to the device. This
+goes further than the CUDA design (SURVEY.md §3.5, one small readback per
+split): here the readback is deferred past the whole training run unless a
+caller needs host trees earlier (save/predict/DART/RF paths).
 """
 
 from __future__ import annotations
@@ -57,7 +60,15 @@ class GBDT:
         self.objective = objective
         self.train_set = train_set
         self.training_metrics = list(training_metrics)
-        self.models: List[Tree] = []
+        self._models: List[Tree] = []
+        # device-resident trees not yet materialized on host: list of
+        # (DeviceTree, bias_to_fold). Drained in ONE device_get by
+        # _materialize_models().
+        self._pending: List[Tuple[Any, float]] = []
+        # how often train_one_iter really checks the "no more splits"
+        # condition; every check costs one host sync, so it is amortized
+        self._stop_check_interval = 32
+        self._stopped = False
         self.iter = 0
         self.num_class = config.num_class
         self.num_tree_per_iteration = (
@@ -279,6 +290,44 @@ class GBDT:
             m.init(ds.metadata, ds.num_data)
 
     # ------------------------------------------------------------------
+    @property
+    def models(self) -> List[Tree]:
+        """Host trees; materializes any pending device trees first."""
+        self._materialize_models()
+        return self._models
+
+    def _materialize_models(self) -> None:
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        # one batched transfer for all pending trees (one host sync)
+        hosts = jax.device_get([t for t, _ in pending])
+        for host, (_, bias) in zip(hosts, pending):
+            tree = self._device_tree_to_host(host)
+            if abs(bias) > _KEPS:
+                tree.add_bias(bias)
+                tree.shrinkage = 1.0
+            self._models.append(tree)
+
+    def _check_stopped(self) -> bool:
+        """Fetch the pending trees' leaf counts (one sync) and report
+        whether the last iteration produced only stumps (reference stop
+        condition, gbdt.cpp:376-384)."""
+        K = self.num_tree_per_iteration
+        if self._pending:
+            counts = jax.device_get(
+                [t.num_leaves for t, _ in self._pending[-K:]])
+        elif self._models:
+            counts = [t.num_leaves for t in self._models[-K:]]
+        else:
+            return False
+        if all(int(c) <= 1 for c in counts):
+            log_warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            return True
+        return False
+
+    # ------------------------------------------------------------------
     def boost(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """Compute gradients from current scores (GBDT::Boosting,
         gbdt.cpp:229)."""
@@ -342,46 +391,40 @@ class GBDT:
 
         lr = jnp.float32(self.shrinkage_rate)
         feat_mask = self._feature_mask_for_iter()
-        all_empty = True
         for k in range(K):
             tree_dev, leaf_of_row, new_scores = self._train_tree(
                 self.X_t, g_dev[k], h_dev[k],
                 in_bag if in_bag.ndim == 1 else in_bag[k],
                 self.scores[k], lr, feat_mask)
-            host = jax.device_get(tree_dev)
-            num_leaves = int(host.num_leaves)
-            if num_leaves > 1:
-                all_empty = False
             self.scores = self.scores.at[k].set(new_scores)
-            tree = self._device_tree_to_host(host)
             # valid scores update BEFORE the bias fold: scorers received the
             # init score separately in _boost_from_average (the reference
-            # updates scores before AddBias, gbdt.cpp:424-428)
-            L = self.grow_cfg.num_leaves
-            leaf_vals = np.zeros(L, dtype=np.float32)
-            leaf_vals[:tree.num_leaves] = tree.leaf_value[:tree.num_leaves]
+            # updates scores before AddBias, gbdt.cpp:424-428). leaf_value
+            # on the DeviceTree is pre-shrinkage, so lr is applied here.
             for vi in range(len(self.valid_sets)):
                 self._valid_scores[vi] = self._valid_scores[vi].at[k].set(
                     self._valid_update(
                         tree_dev.split_feature, tree_dev.threshold_bin,
                         tree_dev.default_left, tree_dev.left_child,
                         tree_dev.right_child, tree_dev.num_leaves,
-                        jnp.asarray(leaf_vals),
+                        tree_dev.leaf_value,
                         self._valid_Xt[vi], tuple(self._valid_meta[vi]),
-                        self._valid_scores[vi][k], jnp.float32(1.0),
+                        self._valid_scores[vi][k], lr,
                         tree_dev.split_is_cat, tree_dev.split_cat_bitset))
-            # fold the boost-from-average bias into the first tree
-            # (gbdt.cpp:425-427)
-            if self.iter == 0 and abs(init_scores[k]) > _KEPS:
-                tree.add_bias(init_scores[k])
-                tree.shrinkage = 1.0
-            self.models.append(tree)
+            # boost-from-average bias is folded into the first tree at
+            # materialization time (gbdt.cpp:425-427)
+            bias = init_scores[k] if self.iter == 0 else 0.0
+            self._pending.append((tree_dev, float(bias)))
 
         self.iter += 1
-        if all_empty:
-            log_warning("Stopped training because there are no more leaves "
-                        "that meet the split requirements")
+        # The stop condition requires a host readback (~100ms on a tunneled
+        # chip), so it is only REALLY evaluated every _stop_check_interval
+        # iterations; in between, training streams fully asynchronously.
+        if self._stopped:
             return True
+        if self.iter % self._stop_check_interval == 0:
+            self._stopped = self._check_stopped()
+            return self._stopped
         return False
 
     def _boost_from_average(self) -> np.ndarray:
@@ -420,6 +463,7 @@ class GBDT:
         """gbdt.cpp:463: undo the last iteration."""
         if self.iter <= 0:
             return
+        self._stopped = False
         K = self.num_tree_per_iteration
         for k in range(K):
             tree = self.models.pop()

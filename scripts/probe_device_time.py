@@ -1,0 +1,135 @@
+"""True device-side cost of each primitive: run n1/n2 reps inside one jit,
+linear-fit out the ~90ms sync latency. N=2M (the planned bench size)."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightgbm_tpu.ops.histogram_pallas import build_histogram_slots_pallas
+
+N, F, B = 2_000_000, 28, 256
+rng = np.random.RandomState(0)
+X_t = jnp.asarray(rng.randint(0, 255, size=(F, N), dtype=np.uint8)
+                  ).astype(jnp.int8)
+X_rm = X_t.T.copy()
+vals3 = jnp.asarray(rng.normal(size=(3, N)).astype(np.float32))
+vals2 = vals3[:2].copy()
+idx = jnp.asarray(rng.permutation(N).astype(np.int32))
+half_idx = idx[: N // 2].copy()
+
+
+def fit(make_loop, n1=4, n2=24):
+    f1, f2 = make_loop(n1), make_loop(n2)
+    t = {}
+    for n, f in ((n1, f1), (n2, f2)):
+        float(np.asarray(f()))
+        best = 1e9
+        for _ in range(2):
+            t0 = time.perf_counter()
+            float(np.asarray(f()))
+            best = min(best, time.perf_counter() - t0)
+        t[n] = best
+    return (t[n2] - t[n1]) / (n2 - n1)
+
+
+def report(name, make_loop, **kw):
+    per = fit(make_loop, **kw)
+    print(f"{name:38s} {per*1e3:9.3f} ms/op", flush=True)
+
+
+def hist_loop(K, C):
+    v = vals3 if C == 3 else vals2
+    slot = jnp.asarray(rng.randint(0, K, size=N, dtype=np.int32))
+    def mk(n):
+        @jax.jit
+        def f():
+            def body(i, acc):
+                h = build_histogram_slots_pallas(X_t, v, slot + (i - i), K, B)
+                return acc + h[0, 0, 0, 0] * 1e-9
+            return jax.lax.fori_loop(0, n, body, jnp.float32(0.0))
+        return f
+    return mk
+
+for K in (1, 2, 4, 8, 16):
+    report(f"hist slots K={K:<2} C=3 N=2M", hist_loop(K, 3))
+report("hist slots K=1  C=2 N=2M", hist_loop(1, 2))
+
+
+def gather_loop(x, ii):
+    def mk(n):
+        @jax.jit
+        def f():
+            def body(i, acc):
+                g = x[(ii + i) % N]
+                return acc + g[0, 0].astype(jnp.float32)
+            return jax.lax.fori_loop(0, n, body, jnp.float32(0.0))
+        return f
+    return mk
+
+report("row gather [N,F] int8 full", gather_loop(X_rm, idx))
+report("row gather [N,F] int8 half", gather_loop(X_rm, half_idx))
+
+
+def valgather_loop():
+    def mk(n):
+        @jax.jit
+        def f():
+            def body(i, acc):
+                g = vals3[:, (idx + i) % N]
+                return acc + g[0, 0]
+            return jax.lax.fori_loop(0, n, body, jnp.float32(0.0))
+        return f
+    return mk
+
+report("val gather [3,N] f32 full", valgather_loop())
+
+
+def part_loop():
+    go = jnp.asarray(rng.rand(N) < 0.5)
+    order0 = jnp.arange(N, dtype=jnp.int32)
+    def mk(n):
+        @jax.jit
+        def f():
+            def body(i, order):
+                gl = go ^ (i % 2 == 0)
+                nl = jnp.sum(gl)
+                pl = jnp.cumsum(gl) - 1
+                pr = nl + jnp.cumsum(~gl) - 1
+                pos = jnp.where(gl, pl, pr)
+                return jnp.zeros_like(order).at[pos].set(order)
+            return jax.lax.fori_loop(0, n, body, order0)[0].astype(
+                jnp.float32)
+        return f
+    return mk
+
+report("partition cumsum+scatter [N]", part_loop())
+
+
+def seg_loop():
+    """leaf-masked histogram via multiply (mask cost reference)."""
+    def mk(n):
+        @jax.jit
+        def f():
+            def body(i, acc):
+                m = (idx > i).astype(jnp.float32)
+                v = vals3 * m[None, :]
+                return acc + v[0, 0]
+            return jax.lax.fori_loop(0, n, body, jnp.float32(0.0))
+        return f
+    return mk
+
+report("mask+mult vals [3,N]", seg_loop())
+
+# elementwise f32 [N] op chain (cost floor of any N-wide op)
+def ew_loop():
+    def mk(n):
+        @jax.jit
+        def f():
+            def body(i, x):
+                return x * 1.000001 + 1e-9
+            return jax.lax.fori_loop(0, n, body, vals3[0])[0]
+        return f
+    return mk
+
+report("elementwise [N] f32 fma", ew_loop())

@@ -1,0 +1,118 @@
+"""Cost of primitives when looped INSIDE one jit (amortizes tunnel dispatch).
+
+Each op is run `R` times via lax.fori_loop with a data dependence that
+prevents elision but adds negligible work; one scalar fetch syncs.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightgbm_tpu.ops.histogram_pallas import build_histogram_slots_pallas
+
+R = 20
+N, F, B = 500_000, 28, 256
+rng = np.random.RandomState(0)
+X_t = jnp.asarray(rng.randint(0, 255, size=(F, N), dtype=np.uint8)
+                  ).astype(jnp.int8)
+X_rm = X_t.T.copy()
+vals3 = jnp.asarray(rng.normal(size=(3, N)).astype(np.float32))
+idx = jnp.asarray(rng.permutation(N).astype(np.int32))
+half_idx = idx[: N // 2]
+
+
+def bench(name, jitted, *args):
+    s = float(np.asarray(jitted(*args)))  # compile+warm
+    t0 = time.perf_counter()
+    s = float(np.asarray(jitted(*args)))
+    t = time.perf_counter() - t0
+    print(f"{name:34s} {t/R*1e3:8.3f} ms/op")
+
+
+# chained matmul
+a = jnp.asarray(rng.rand(4096, 4096).astype(np.float32)).astype(jnp.bfloat16)
+
+@jax.jit
+def mm_loop(x):
+    def body(i, x):
+        return (x @ x) * jnp.bfloat16(1e-6) + jnp.bfloat16(0.5)
+    return jnp.sum(jax.lax.fori_loop(0, R, body, x).astype(jnp.float32))
+
+bench("matmul 4096^3 bf16", mm_loop, a)
+
+
+# hist pass, perturb slot each iter to avoid CSE
+def make_hist_loop(K):
+    @jax.jit
+    def hist_loop(X, v, slot):
+        def body(i, acc):
+            h = build_histogram_slots_pallas(X, v, slot + (i - i), K, B)
+            return acc + jnp.sum(h) * 1e-9
+        return jax.lax.fori_loop(0, R, body, jnp.float32(0.0))
+    return hist_loop
+
+for K in (1, 2, 8):
+    slot = jnp.asarray(rng.randint(0, K, size=N, dtype=np.int32))
+    bench(f"hist slots K={K} full N", make_hist_loop(K), X_t, vals3, slot)
+
+
+@jax.jit
+def gather_loop(x, i0):
+    def body(i, acc):
+        g = x[(i0 + i) % N]
+        return acc + jnp.sum(g.astype(jnp.float32)) * 1e-9
+    return jax.lax.fori_loop(0, R, body, jnp.float32(0.0))
+
+bench("row gather [N,F] int8 all", gather_loop, X_rm, idx)
+bench("row gather [N,F] int8 N/2", gather_loop, X_rm, half_idx)
+
+
+@jax.jit
+def colgather_loop(x, i0):
+    def body(i, acc):
+        g = jnp.take(x, (i0 + i) % N, axis=1)
+        return acc + jnp.sum(g.astype(jnp.float32)) * 1e-9
+    return jax.lax.fori_loop(0, R, body, jnp.float32(0.0))
+
+bench("col gather [F,N] int8 N/2", colgather_loop, X_t, half_idx)
+
+
+@jax.jit
+def valgather_loop(v, i0):
+    def body(i, acc):
+        g = v[:, (i0 + i) % N]
+        return acc + jnp.sum(g) * 1e-9
+    return jax.lax.fori_loop(0, R, body, jnp.float32(0.0))
+
+bench("val gather [3,N] f32 N/2", valgather_loop, vals3, half_idx)
+
+
+go = jnp.asarray(rng.rand(N) < 0.5)
+order0 = jnp.arange(N, dtype=jnp.int32)
+
+@jax.jit
+def part_loop(order, go):
+    def body(i, order):
+        gl = go ^ (i % 2 == 0)
+        nl = jnp.sum(gl)
+        pl = jnp.cumsum(gl) - 1
+        pr = nl + jnp.cumsum(~gl) - 1
+        pos = jnp.where(gl, pl, pr)
+        return jnp.zeros_like(order).at[pos].set(order)
+    return jnp.sum(jax.lax.fori_loop(0, R, body, order).astype(jnp.float32))
+
+bench("partition cumsum+scatter", part_loop, order0, go)
+
+
+@jax.jit
+def noop_loop(x):
+    def body(i, x):
+        return x + 1.0
+    return jnp.sum(jax.lax.fori_loop(0, R * 50, body, x))
+
+t0 = time.perf_counter()
+float(np.asarray(noop_loop(jnp.zeros((8, 128)))))
+float(np.asarray(noop_loop(jnp.zeros((8, 128)))))
+print(f"{'in-loop trivial step':34s} "
+      f"{(time.perf_counter()-t0)/2/(R*50)*1e3:8.4f} ms/op")

@@ -1,0 +1,54 @@
+"""How does on-device loop cost scale with step count? Is there a per-step
+relay overhead under axon, and does scan differ from fori_loop?"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def run(f, *args):
+    float(np.asarray(f(*args)))  # compile
+    t0 = time.perf_counter()
+    float(np.asarray(f(*args)))
+    return time.perf_counter() - t0
+
+
+x0 = jnp.zeros((8, 128))
+
+for n in (10, 100, 1000):
+    f = jax.jit(lambda x, n=n: jnp.sum(
+        jax.lax.fori_loop(0, n, lambda i, x: x + 1.0, x)))
+    t = run(f, x0)
+    print(f"fori_loop n={n:<5d} trivial:   total {t*1e3:9.2f} ms  "
+          f"per-step {t/n*1e6:8.1f} us")
+
+for n in (10, 100, 1000):
+    f = jax.jit(lambda x, n=n: jnp.sum(
+        jax.lax.scan(lambda c, _: (c + 1.0, None), x,
+                     None, length=n)[0]))
+    t = run(f, x0)
+    print(f"scan      n={n:<5d} trivial:   total {t*1e3:9.2f} ms  "
+          f"per-step {t/n*1e6:8.1f} us")
+
+# unrolled inside one jit
+for n in (100, 1000):
+    def mk(n):
+        @jax.jit
+        def f(x):
+            for _ in range(n):
+                x = x + 1.0
+            return jnp.sum(x)
+        return f
+    t = run(mk(n), x0)
+    print(f"unrolled  n={n:<5d} trivial:   total {t*1e3:9.2f} ms  "
+          f"per-step {t/n*1e6:8.1f} us")
+
+# medium-work loop body: [1024,1024] matmul
+a = jnp.asarray(np.random.rand(1024, 1024).astype(np.float32))
+for n in (5, 50):
+    f = jax.jit(lambda x, n=n: jnp.sum(jax.lax.fori_loop(
+        0, n, lambda i, x: (x @ x) * 1e-3, x)))
+    t = run(f, a)
+    print(f"fori_loop n={n:<5d} mm1024:    total {t*1e3:9.2f} ms  "
+          f"per-step {t/n*1e6:8.1f} us")

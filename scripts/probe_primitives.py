@@ -1,0 +1,92 @@
+"""Measure primitive costs on the real chip to validate the wave design.
+
+Under the axon tunnel `block_until_ready` does not wait, so every timing
+fetches a scalar reduction to host (np.asarray) after n chained/batched
+iterations; the scalar transfer is ~free vs the op under test.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightgbm_tpu.ops.histogram_pallas import (
+    build_histogram_pallas, build_histogram_slots_pallas)
+
+
+def sync(x):
+    return float(np.asarray(jnp.sum(x.astype(jnp.float32))
+                            if x.dtype != jnp.float32 else jnp.sum(x)))
+
+
+def timeit(fn, *args, n=20):
+    sync(fn(*args))  # warmup/compile
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = fn(*args)
+    s = sync(r)
+    return (time.perf_counter() - t0) / n
+
+
+N, F, B = 500_000, 28, 256
+rng = np.random.RandomState(0)
+X_t = jnp.asarray(rng.randint(0, 255, size=(F, N), dtype=np.uint8)
+                  ).astype(jnp.int8)
+X_rm = X_t.T.copy()  # row-major [N, F]
+vals3 = jnp.asarray(rng.normal(size=(3, N)).astype(np.float32))
+idx = jnp.asarray(rng.permutation(N).astype(np.int32))
+half_idx = idx[: N // 2]
+
+# matmul calibration: 10 chained 4096^3 bf16 matmuls = 0.137 TFLOP each
+a = jnp.asarray(rng.rand(4096, 4096).astype(np.float32)).astype(jnp.bfloat16)
+mm = jax.jit(lambda x: (x @ x) * jnp.bfloat16(1e-3))
+t = timeit(mm, a)
+print(f"matmul 4096^3 bf16:        {t*1e3:8.3f} ms "
+      f"({2*4096**3/t/1e12:.0f} TFLOP/s)")
+
+t = timeit(lambda: build_histogram_pallas(X_t, vals3, B))
+print(f"hist K=1 full N pass:      {t*1e3:8.3f} ms")
+
+for K in (2, 8, 32):
+    slot = jnp.asarray(rng.randint(0, K, size=N, dtype=np.int32))
+    t = timeit(lambda s=slot, k=K: build_histogram_slots_pallas(
+        X_t, vals3, s, k, B))
+    print(f"hist slots K={K:<3} full N:    {t*1e3:8.3f} ms")
+
+f = jax.jit(lambda x, i: x[i])
+t = timeit(f, X_rm, idx)
+print(f"row gather [N,F] int8 all: {t*1e3:8.3f} ms")
+t = timeit(f, X_rm, half_idx)
+print(f"row gather [N,F] int8 N/2: {t*1e3:8.3f} ms")
+
+g = jax.jit(lambda x, i: jnp.take(x, i, axis=1))
+t = timeit(g, X_t, half_idx)
+print(f"col gather [F,N] int8 N/2: {t*1e3:8.3f} ms")
+
+gv = jax.jit(lambda v, i: v[:, i])
+t = timeit(gv, vals3, half_idx)
+print(f"val gather [3,N] f32 N/2:  {t*1e3:8.3f} ms")
+
+def part(order, go_left):
+    nl = jnp.sum(go_left)
+    pl = jnp.cumsum(go_left) - 1
+    pr = nl + jnp.cumsum(~go_left) - 1
+    pos = jnp.where(go_left, pl, pr)
+    return jnp.zeros_like(order).at[pos].set(order)
+
+go = jnp.asarray(rng.rand(N) < 0.5)
+order0 = jnp.arange(N, dtype=jnp.int32)
+t = timeit(jax.jit(part), order0, go)
+print(f"partition cumsum+scatter:  {t*1e3:8.3f} ms")
+
+t = timeit(jax.jit(lambda o, i: jnp.zeros_like(o).at[i].set(o)), order0, idx)
+print(f"scatter [N] i32 by perm:   {t*1e3:8.3f} ms")
+
+t = timeit(jax.jit(lambda x: x.T.copy()), X_rm)
+print(f"transpose [N,F]->[F,N]:    {t*1e3:8.3f} ms")
+
+# dispatch overhead: trivial jitted op
+tiny = jax.jit(lambda x: x + 1.0)
+z = jnp.zeros((8, 128))
+t = timeit(tiny, z, n=200)
+print(f"trivial dispatch:          {t*1e3:8.3f} ms")
