@@ -23,9 +23,9 @@ BASELINE_ROW_ITERS_PER_SEC = 10_500_000 * 500 / 130.094
 
 
 def main() -> None:
-    rows = int(os.environ.get("BENCH_ROWS", "500000"))
+    rows = int(os.environ.get("BENCH_ROWS", "4000000"))
     cols = int(os.environ.get("BENCH_COLS", "28"))
-    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    iters = int(os.environ.get("BENCH_ITERS", "32"))
     num_leaves = int(os.environ.get("BENCH_LEAVES", "255"))
 
     rng = np.random.RandomState(42)
@@ -54,12 +54,11 @@ def main() -> None:
         return jnp.sum(b._gbdt.scores)
 
     booster = lgb.Booster(params=params, train_set=ds)
-    booster.update()
+    booster.update_batch(iters)
     barrier(booster)
 
     t0 = time.perf_counter()
-    for _ in range(iters):
-        booster.update()
+    booster.update_batch(iters)
     barrier(booster)
     dt = time.perf_counter() - t0
 

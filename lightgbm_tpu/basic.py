@@ -262,6 +262,31 @@ class Booster:
                                              np.asarray(hess))
         return self._gbdt.train_one_iter()
 
+    def update_batch(self, n: int, chunk: int = 32) -> None:
+        """Run `n` boosting iterations with whole-chunk device scans (no
+        host round-trip per iteration) when semantics allow, else fall
+        back to per-iteration updates. TPU-native extension; the
+        reference's per-iteration C API boundary (LGBM_BoosterUpdateOneIter)
+        has no batched analog."""
+        if self._gbdt._stopped:
+            return
+        done = 0
+        chunks_done = 0
+        if self._gbdt.can_batch_iters(n):
+            while n - done >= chunk:
+                self._gbdt.train_iters_batched(chunk)
+                done += chunk
+                chunks_done += 1
+                # amortized no-more-splits check (one sync) at power-of-2
+                # chunk counts, mirroring train_one_iter's policy
+                if (chunks_done & (chunks_done - 1)) == 0 \
+                        and self._gbdt._check_stopped():
+                    self._gbdt._stopped = True
+                    return
+        for _ in range(n - done):
+            if self.update():
+                break
+
     def __inner_raw_score(self) -> np.ndarray:
         import jax
         # slice off data-parallel padding rows (scores are [K, N_pad])

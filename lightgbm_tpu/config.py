@@ -151,10 +151,14 @@ class Config:
     same XLA graphs on the host platform.
     """
 
-    # -- core (tpu_grower: "auto" picks the compacted per-leaf grower when
-    # the per-leaf histogram cache fits in memory, else the masked full-scan
-    # grower; "compact"/"masked" force one — the TPU analog of the
-    # reference's force_col_wise/force_row_wise histogram-mode switch)
+    # -- core (tpu_grower: "auto" picks the wave grower — gain-ordered
+    # batched frontier splits per histogram pass, ops/grow_wave.py — when
+    # its histogram caches fit in memory, else compact, else the masked
+    # full-scan grower; "wave"/"wave_exact"/"compact"/"masked" force one —
+    # the TPU analog of the reference's force_col_wise/force_row_wise
+    # histogram-mode switch. "wave" batches the split ORDER (quality ~=
+    # leaf-wise, measured on the parity gates); "wave_exact"/"compact"/
+    # "masked" reproduce the reference's strict leaf-wise order.)
     tpu_grower: str = "auto"
     task: str = "train"
     data: str = ""
@@ -314,6 +318,10 @@ class Config:
     # TPU-specific knobs (new in this framework)
     tpu_hist_dtype: str = "float32"    # float32 | bfloat16 | int8 (quantized)
     tpu_rows_per_block: int = 1024     # pallas histogram kernel row block
+    # wave grower: a ready leaf splits only if its gain >= slack * (best
+    # frontier gain); raises order fidelity vs strict leaf-wise (see
+    # ops/grow.py GrowConfig.wave_gain_slack)
+    tpu_wave_gain_slack: float = 0.4
     tpu_num_shards: int = 0            # 0 = use all local devices for data ||
 
     def __post_init__(self) -> None:

@@ -73,6 +73,17 @@ def train(
     callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
     callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
+    # whole-chunk device training when nothing needs per-iteration host
+    # interaction (no callbacks/eval/custom objective): the boosting loop
+    # runs as jitted scans with zero host round-trips
+    if (not callbacks_before and not callbacks_after and fobj is None
+            and feval is None and not valid_contain_train
+            and not booster.name_valid_sets
+            and booster._gbdt.can_batch_iters(num_boost_round)):
+        booster.update_batch(num_boost_round)
+        booster.best_iteration = booster.current_iteration
+        return booster
+
     for it in range(num_boost_round):
         for cb in callbacks_before:
             cb(CallbackEnv(model=booster, params=params, iteration=it,

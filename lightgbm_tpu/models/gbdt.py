@@ -148,21 +148,36 @@ class GBDT:
             cat_l2=cfg.cat_l2,
             cat_smooth=cfg.cat_smooth,
             min_data_per_group=float(cfg.min_data_per_group),
+            wave_exact=(cfg.tpu_grower == "wave_exact"),
+            # slack >= 1 would block the top ready leaf forever (device
+            # while_loop livelock); clamp below 1
+            wave_gain_slack=min(max(cfg.tpu_wave_gain_slack, 0.0), 0.99),
         )
 
-        # grower selection: the compact path needs the per-leaf histogram
-        # cache [L, F, B, 3] resident (the reference bounds the same
-        # structure with histogram_pool_size, serial_tree_learner.cpp:40)
+        # grower selection: "wave" (default via auto) applies batched
+        # gain-ordered frontier splits per histogram pass; "wave_exact"
+        # keeps strict leaf-wise priority order on the wave machinery;
+        # "compact"/"masked" are the serial growers. The wave paths keep
+        # TWO [L, 3, F, B] histogram caches resident (own + speculated
+        # smaller-child) plus ~2 [KMAX, 3, F, B] wave temporaries (the
+        # reference bounds the analogous structure with
+        # histogram_pool_size, serial_tree_learner.cpp:40)
+        from ..ops.grow_wave import _wave_buckets
         cache_bytes = (cfg.num_leaves * len(ds.mappers)
                        * self.num_bins_padded * 3 * 4)
+        wave_bytes = cache_bytes * 2 + (
+            _wave_buckets(cfg.num_leaves)[-1] * len(ds.mappers)
+            * self.num_bins_padded * 3 * 4) * 2
         pool_limit = (cfg.histogram_pool_size * 1024 * 1024
                       if cfg.histogram_pool_size > 0 else 512 * 1024 * 1024)
-        if cfg.tpu_grower == "compact":
-            self.use_compact = True
-        elif cfg.tpu_grower == "masked":
-            self.use_compact = False
+        if cfg.tpu_grower in ("compact", "masked", "wave", "wave_exact"):
+            self.grower = cfg.tpu_grower
+        elif wave_bytes <= pool_limit:
+            self.grower = "wave"
+        elif cache_bytes <= pool_limit:
+            self.grower = "compact"
         else:
-            self.use_compact = cache_bytes <= pool_limit
+            self.grower = "masked"
 
         K = self.num_tree_per_iteration
         N = self.num_data
@@ -216,7 +231,9 @@ class GBDT:
         cfg_static = self.grow_cfg
         meta = self.meta
 
-        if self.use_compact:
+        if self.grower in ("wave", "wave_exact"):
+            from ..ops.grow_wave import grow_tree_wave as grow_fn
+        elif self.grower == "compact":
             from ..ops.grow_fast import grow_tree_fast as grow_fn
         else:
             grow_fn = grow_tree
@@ -300,14 +317,24 @@ class GBDT:
         if not self._pending:
             return
         pending, self._pending = self._pending, []
-        # one batched transfer for all pending trees (one host sync)
+        # one batched transfer for all pending trees (one host sync).
+        # Records are either a single DeviceTree (bias: float) or a chunk
+        # of trees stacked [n, K, ...] (bias: list, iteration-major).
         hosts = jax.device_get([t for t, _ in pending])
         for host, (_, bias) in zip(hosts, pending):
-            tree = self._device_tree_to_host(host)
-            if abs(bias) > _KEPS:
-                tree.add_bias(bias)
-                tree.shrinkage = 1.0
-            self._models.append(tree)
+            if isinstance(bias, list):
+                flat = [jax.tree.map(
+                    lambda a, i=i, k=k: a[i, k], host)
+                    for i in range(host.num_leaves.shape[0])
+                    for k in range(host.num_leaves.shape[1])]
+            else:
+                flat = [host]
+                bias = [bias]
+            for h, b in zip(flat, bias):
+                tree = self._device_tree_to_host(h)
+                if abs(b) > _KEPS:
+                    tree.add_bias(b)
+                self._models.append(tree)
 
     def _check_stopped(self) -> bool:
         """Fetch the pending trees' leaf counts (one sync) and report
@@ -315,8 +342,17 @@ class GBDT:
         condition, gbdt.cpp:376-384)."""
         K = self.num_tree_per_iteration
         if self._pending:
-            counts = jax.device_get(
-                [t.num_leaves for t, _ in self._pending[-K:]])
+            # gather the last K tree leaf-counts in ONE batched transfer
+            # (records may be single trees or stacked chunks)
+            take, need = [], K
+            for trees, _ in reversed(self._pending):
+                take.append(trees.num_leaves)
+                need -= int(np.prod(np.shape(trees.num_leaves)) or 1)
+                if need <= 0:
+                    break
+            got = jax.device_get(take)
+            counts = [c for g in reversed(got)
+                      for c in np.asarray(g).reshape(-1)][-K:]
         elif self._models:
             counts = [t.num_leaves for t in self._models[-K:]]
         else:
@@ -352,6 +388,98 @@ class GBDT:
             return (self._put_rows(jnp.asarray(g), row_axis=1),
                     self._put_rows(jnp.asarray(h), row_axis=1))
         return self._grad_fn(self.scores, self.label_dev, self.weight_dev)
+
+    # ------------------------------------------------------------------
+    def can_batch_iters(self, n: int) -> bool:
+        """Whether `n` whole-chunk device iterations (train_iters_batched)
+        are semantically equivalent to repeated train_one_iter calls:
+        plain GBDT, device-side objective, no re-sampling inside the
+        window."""
+        if type(self) is not GBDT:
+            return False          # DART/RF override per-iter behavior
+        if self.objective is None or self.objective.runs_on_host:
+            return False
+        if self.valid_sets:
+            return False          # valid-score replay is per-iteration
+        if any(self.sample_strategy.resamples_at(self.iter + i)
+               for i in range(1, n)):
+            return False
+        return True
+
+    def train_iters_batched(self, n: int) -> None:
+        """Run `n` boosting iterations as ONE jitted lax.scan — no host
+        round-trips at all (the reference's TrainOneIter loop,
+        gbdt.cpp:246-265, with the per-iteration host boundary removed).
+        Caller must have checked can_batch_iters()."""
+        K = self.num_tree_per_iteration
+        init_scores = np.zeros(K)
+        if self.iter == 0:
+            init_scores = self._boost_from_average()
+        if self._in_bag_dev is None \
+                or self.sample_strategy.resamples_at(self.iter):
+            in_bag = self.sample_strategy.sample(self.iter, None, None)
+            if self.N_pad != self.num_data:
+                in_bag = jnp.pad(in_bag,
+                                 (0, self.N_pad - self.num_data))
+            self._in_bag_dev = self._put_rows(in_bag, row_axis=0)
+
+        # per-iteration feature masks, precomputed host-side (same RNG
+        # stream as the per-iteration path)
+        F = len(self.mappers)
+        masks_dev = jnp.stack([
+            m if m is not None else jnp.ones((F,), bool)
+            for m in (self._feature_mask_for_iter(self.iter + i)
+                      for i in range(n))])
+
+        scan_fn = self._get_scan_fn(n)
+        new_scores, tree_stack = scan_fn(
+            self.X_t, self.scores, self.label_dev, self.weight_dev,
+            self._in_bag_dev, jnp.float32(self.shrinkage_rate), masks_dev)
+        self.scores = new_scores
+        # ONE stacked pending record for the whole chunk (slicing happens
+        # host-side at materialization — per-tree device slices would
+        # reintroduce hundreds of dispatches); iteration-0 bias folds into
+        # the first tree
+        biases = [
+            float(init_scores[k]) if (self.iter + i) == 0 else 0.0
+            for i in range(n) for k in range(K)]
+        self._pending.append((tree_stack, biases))
+        self.iter += n
+
+    def _get_scan_fn(self, n: int):
+        key = (n, self.num_tree_per_iteration)
+        cache = getattr(self, "_scan_fns", None)
+        if cache is None:
+            cache = self._scan_fns = {}
+        if key in cache:
+            return cache[key]
+        K = self.num_tree_per_iteration
+        obj = self.objective
+        train_tree = self._train_tree
+
+        @jax.jit
+        def scan_fn(X_t, scores0, label, weight, in_bag, lr, masks):
+            def step(scores, mask):
+                if K == 1:
+                    g, h = obj.get_gradients(scores[0], label, weight)
+                    g, h = g[None, :], h[None, :]
+                else:
+                    g, h = obj.get_gradients(scores, label, weight)
+                trees = []
+                for k in range(K):
+                    tree, _, ns = train_tree(
+                        X_t, g[k], h[k],
+                        in_bag if in_bag.ndim == 1 else in_bag[k],
+                        scores[k], lr, mask)
+                    scores = scores.at[k].set(ns)
+                    trees.append(tree)
+                stacked = jax.tree.map(lambda *a: jnp.stack(a), *trees)
+                return scores, stacked
+
+            return jax.lax.scan(step, scores0, masks)
+
+        cache[key] = scan_fn
+        return scan_fn
 
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
@@ -418,11 +546,15 @@ class GBDT:
 
         self.iter += 1
         # The stop condition requires a host readback (~100ms on a tunneled
-        # chip), so it is only REALLY evaluated every _stop_check_interval
-        # iterations; in between, training streams fully asynchronously.
+        # chip), so it is only REALLY evaluated at power-of-2 iterations and
+        # then every _stop_check_interval; in between, training streams
+        # fully asynchronously. Worst case this appends a few extra
+        # constant-zero trees past exhaustion (harmless to scores: stump
+        # trees carry value 0, mirroring AsConstantTree(0), gbdt.cpp:443).
         if self._stopped:
             return True
-        if self.iter % self._stop_check_interval == 0:
+        it = self.iter
+        if (it & (it - 1)) == 0 or it % self._stop_check_interval == 0:
             self._stopped = self._check_stopped()
             return self._stopped
         return False
@@ -445,7 +577,8 @@ class GBDT:
                 log_info(f"Start training from score {init_scores[k]:.6f}")
         return init_scores
 
-    def _feature_mask_for_iter(self) -> Optional[jnp.ndarray]:
+    def _feature_mask_for_iter(
+            self, it: Optional[int] = None) -> Optional[jnp.ndarray]:
         frac = self.config.feature_fraction
         F = len(self.mappers)
         if frac >= 1.0:
@@ -454,7 +587,8 @@ class GBDT:
             return jnp.ones((F,), bool) if self.use_dist else None
         used = max(1, int(round(F * frac)))
         rng = np.random.RandomState(
-            self.config.feature_fraction_seed + self.iter)
+            self.config.feature_fraction_seed
+            + (self.iter if it is None else it))
         mask = np.zeros(F, dtype=bool)
         mask[rng.choice(F, used, replace=False)] = True
         return jnp.asarray(mask)

@@ -62,6 +62,17 @@ class GrowConfig(NamedTuple):
     cat_l2: float = 10.0
     cat_smooth: float = 10.0
     min_data_per_group: float = 100.0
+    # wave grower order semantics: False = apply ready leaves per wave in
+    # gain order (TPU-native batched frontier, ~log L histogram passes per
+    # tree); True = strict leaf-wise priority order (blocks on leaves
+    # whose child histograms aren't speculated yet; ~O(chain) passes)
+    wave_exact: bool = False
+    # batched-order guard: a ready leaf only splits in this wave if its
+    # gain >= wave_gain_slack * (best gain anywhere in the frontier,
+    # including not-yet-ready children). 0 = split everything ready;
+    # higher values approach strict leaf-wise order at the cost of more
+    # waves
+    wave_gain_slack: float = 0.0
 
     @property
     def hp(self) -> SplitHyperParams:
@@ -110,6 +121,9 @@ class DeviceTree(NamedTuple):
     split_parent_leaf: jnp.ndarray  # [M] i32: which leaf each split divided
     split_is_cat: jnp.ndarray      # [M] bool: categorical (bitset) split
     split_cat_bitset: jnp.ndarray  # [M, W] u32: left-set over bins
+    num_waves: jnp.ndarray         # i32: histogram waves used (diagnostic,
+    #                                maintained by the wave grower; the
+    #                                serial growers leave it 0)
 
 
 class _LoopState(NamedTuple):
@@ -230,13 +244,17 @@ def grow_tree(
         internal_value=jnp.zeros((M,), jnp.float32),
         internal_weight=jnp.zeros((M,), jnp.float32),
         internal_count=jnp.zeros((M,), jnp.int32),
-        leaf_value=jnp.zeros((L,), jnp.float32).at[0].set(root_out),
+        # leaf 0 stays 0.0 until a split sets it: a no-split tree must be a
+        # constant-zero tree (AsConstantTree(0), gbdt.cpp:443), NOT the root
+        # output
+        leaf_value=jnp.zeros((L,), jnp.float32),
         leaf_weight=jnp.zeros((L,), jnp.float32).at[0].set(root_h),
         leaf_count=jnp.zeros((L,), jnp.int32).at[0].set(
             root_c.astype(jnp.int32)),
         split_parent_leaf=jnp.zeros((M,), jnp.int32),
         split_is_cat=jnp.zeros((M,), bool),
         split_cat_bitset=jnp.zeros((M, W), jnp.uint32),
+        num_waves=jnp.asarray(0, jnp.int32),
     )
     cache = _set_cache(_empty_split_cache(L), 0, root_split, True)
     state = _LoopState(

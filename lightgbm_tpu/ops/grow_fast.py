@@ -144,13 +144,17 @@ def grow_tree_fast(
         internal_value=jnp.zeros((M,), jnp.float32),
         internal_weight=jnp.zeros((M,), jnp.float32),
         internal_count=jnp.zeros((M,), jnp.int32),
-        leaf_value=jnp.zeros((L,), jnp.float32).at[0].set(root_out),
+        # leaf 0 stays 0.0 until a split sets it: a no-split tree must be a
+        # constant-zero tree (AsConstantTree(0), gbdt.cpp:443), NOT the root
+        # output
+        leaf_value=jnp.zeros((L,), jnp.float32),
         leaf_weight=jnp.zeros((L,), jnp.float32).at[0].set(root_h),
         leaf_count=jnp.zeros((L,), jnp.int32).at[0].set(
             root_c.astype(jnp.int32)),
         split_parent_leaf=jnp.zeros((M,), jnp.int32),
         split_is_cat=jnp.zeros((M,), bool),
         split_cat_bitset=jnp.zeros((M, W), jnp.uint32),
+        num_waves=jnp.asarray(0, jnp.int32),
     )
     hist_cache = jnp.zeros((L, 3, F, B), jnp.float32).at[0].set(hist_root)
     state = _FastState(

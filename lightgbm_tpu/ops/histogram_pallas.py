@@ -133,7 +133,13 @@ def build_histogram_slots_pallas(
     C = vals.shape[0]
     K = num_slots
     B, LO, HB = _compute_dims(num_bins)
-    Fp = _round_up(F, F_BLK)
+    # the [K, C, f_blk, B] f32 out block is double-buffered across the
+    # feature grid and must stay well inside scoped VMEM (16MB) next to the
+    # W/one-hot temporaries; shrink the feature block for wide waves
+    f_blk = F_BLK
+    while K * C * f_blk * B * 4 > 3_300_000 and f_blk > 8:
+        f_blk //= 2
+    Fp = _round_up(F, f_blk)
     n_blk = N_BLK if N >= N_BLK else max(_round_up(N, 256), 256)
     Np = _round_up(N, n_blk)
 
@@ -146,20 +152,20 @@ def build_histogram_slots_pallas(
         v = jnp.pad(v, ((0, 0), (0, Np - N)))
         s = jnp.pad(s, (0, Np - N), constant_values=-1)
 
-    grid = (Fp // F_BLK, Np // n_blk)
+    grid = (Fp // f_blk, Np // n_blk)
     kernel = functools.partial(_slots_kernel, K=K, C=C, B=B, LO=LO, HB=HB)
     out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((F_BLK, n_blk), lambda f, n: (f, n),
+            pl.BlockSpec((f_blk, n_blk), lambda f, n: (f, n),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((C, n_blk), lambda f, n: (0, n),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, n_blk), lambda f, n: (0, n),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((K, C, F_BLK, B), lambda f, n: (0, 0, f, 0),
+        out_specs=pl.BlockSpec((K, C, f_blk, B), lambda f, n: (0, 0, f, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((K, C, Fp, B), jnp.float32),
         interpret=interpret,

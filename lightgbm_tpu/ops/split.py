@@ -194,9 +194,20 @@ def find_best_split(
     f = (best // B) % F
     t = best % B
 
-    # one fused gather for all per-split stats instead of 8 tiny ones
-    stats = jnp.stack([lg, lh, lc, rg, rh, rc, lout, rout])  # [8, 2, F, B]
-    picked = stats.reshape(8, -1)[:, best]
+    # pick per-split stats with a one-hot dot (exact: single 1.0 product).
+    # A stacked [8, 2, F, B] gather materializes ~117MB + relayout copies
+    # when vmapped over a 256-leaf wave; the one-hot contraction fuses.
+    onehot = (jnp.arange(2 * F * B, dtype=jnp.int32) == best
+              ).astype(jnp.float32)
+
+    def pick(x):
+        # non-selected entries may be inf/NaN (e.g. division by zero-hess
+        # bins); 0.0 * inf = NaN would poison the contraction
+        xf = x.reshape(-1)
+        return jnp.dot(jnp.where(jnp.isfinite(xf), xf, 0.0), onehot,
+                       preferred_element_type=jnp.float32)
+
+    picked = [pick(x) for x in (lg, lh, lc, rg, rh, rc, lout, rout)]
 
     return SplitResult(
         gain=jnp.where(jnp.isfinite(best_gain),

@@ -1,0 +1,53 @@
+"""Isolate grow_tree_wave cost: time repeated in-jit tree growths, varying
+num_leaves, bypassing all Booster machinery."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightgbm_tpu.ops.grow import GrowConfig
+from lightgbm_tpu.ops.grow_wave import grow_tree_wave
+from lightgbm_tpu.ops.split import FeatureMeta
+
+N, F, B = 500_000, 28, 256
+rng = np.random.RandomState(0)
+X_t = jnp.asarray(rng.randint(0, 255, size=(F, N), dtype=np.uint8)
+                  ).astype(jnp.int8)
+w = rng.normal(size=F)
+logit = (np.asarray(X_t.T, np.float32) / 128.0 - 1.0) @ w
+y = (logit + rng.normal(scale=0.5, size=N) > 0).astype(np.float32)
+p = 1.0 / (1.0 + np.exp(-0.0))
+grad = jnp.asarray(p - y, jnp.float32)
+hess = jnp.full((N,), p * (1 - p), jnp.float32)
+in_bag = jnp.ones((N,), jnp.float32)
+meta = FeatureMeta(
+    num_bins=jnp.full((F,), 256, jnp.int32),
+    missing_type=jnp.zeros((F,), jnp.int32),
+    default_bin=jnp.zeros((F,), jnp.int32),
+    is_categorical=jnp.zeros((F,), bool),
+)
+
+for L in (2, 15, 63, 255):
+    cfg = GrowConfig(
+        num_leaves=L, max_depth=0, min_data_in_leaf=20.0,
+        min_sum_hessian_in_leaf=1e-3, lambda_l1=0.0, lambda_l2=0.0,
+        max_delta_step=0.0, min_gain_to_split=0.0, path_smooth=0.0,
+        num_bins_padded=B)
+
+    @jax.jit
+    def run(g):
+        def body(i, acc):
+            tree, lor = grow_tree_wave(X_t, g + i * 1e-9, hess, in_bag,
+                                       meta, cfg)
+            return acc + tree.leaf_value[0] + lor[0]
+        return jax.lax.fori_loop(0, 5, body, jnp.float32(0.0))
+
+    t0 = time.perf_counter()
+    float(np.asarray(run(grad)))
+    compile_t = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    float(np.asarray(run(grad)))
+    t = time.perf_counter() - t0
+    print(f"L={L:<4d} compile {compile_t:6.1f}s  run5 {t*1e3:8.1f} ms "
+          f"-> {(t*1e3 - 90) / 5:7.1f} ms/tree (sync-adjusted)", flush=True)

@@ -1,0 +1,143 @@
+"""Break down wave-step component costs at N=1M and count actual waves."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from lightgbm_tpu.ops.grow import GrowConfig
+import lightgbm_tpu.ops.grow_wave as gw
+from lightgbm_tpu.ops.histogram_pallas import build_histogram_slots_pallas
+from lightgbm_tpu.ops.split import FeatureMeta, find_best_split
+
+N, F, B, L = 1_000_000, 28, 256, 255
+rng = np.random.RandomState(0)
+X_t = jnp.asarray(rng.randint(0, 255, size=(F, N), dtype=np.uint8)
+                  ).astype(jnp.int8)
+w = rng.normal(size=F)
+logit = (np.asarray(X_t.T, np.float32) / 128.0 - 1.0) @ w
+y = (logit + rng.normal(scale=0.5, size=N) > 0).astype(np.float32)
+grad = jnp.asarray(0.5 - y, jnp.float32)
+hess = jnp.full((N,), 0.25, jnp.float32)
+in_bag = jnp.ones((N,), jnp.float32)
+vals = jnp.stack([grad, hess, in_bag])
+meta = FeatureMeta(
+    num_bins=jnp.full((F,), 256, jnp.int32),
+    missing_type=jnp.zeros((F,), jnp.int32),
+    default_bin=jnp.zeros((F,), jnp.int32),
+    is_categorical=jnp.zeros((F,), bool),
+)
+
+
+def timeloop(name, body, n=20):
+    @jax.jit
+    def run():
+        def f(i, acc):
+            return acc + body(i)
+        return jax.lax.fori_loop(0, n, f, jnp.float32(0.0))
+    float(np.asarray(run()))
+    t0 = time.perf_counter()
+    float(np.asarray(run()))
+    t = time.perf_counter() - t0
+    print(f"{name:44s} {(t - 0.09) / n * 1e3:8.2f} ms/op", flush=True)
+
+
+slot = jnp.asarray(rng.randint(0, 8, size=N, dtype=np.int32))
+for K in (8, 32, 128):
+    timeloop(f"hist slots K={K}",
+             lambda i, K=K: build_histogram_slots_pallas(
+                 X_t, vals, slot + (i - i), K, B)[0, 0, 0, 0])
+
+leaf_of_row = jnp.asarray(rng.randint(0, L, size=N, dtype=np.int32))
+tbl_feat = jnp.asarray(rng.randint(0, F, size=128, dtype=np.int32))
+tbl = jnp.asarray(rng.randint(0, L, size=(L,), dtype=np.int32)) % 128
+
+
+def rowpass(i):
+    slot_ = tbl[leaf_of_row]
+    feat = tbl_feat[jnp.maximum(slot_, 0)]
+    col = jnp.zeros((N,), jnp.int32)
+    for f in range(F):
+        col = jnp.where(feat == f, X_t[f].astype(jnp.int32), col)
+    return jnp.sum((col + i) % 7).astype(jnp.float32) * 1e-9
+
+
+timeloop("table row pass (F selects)", rowpass)
+
+hist_cache = jnp.zeros((L, 3, F, B), jnp.float32)
+idx = jnp.asarray(rng.randint(0, L, size=128, dtype=np.int32))
+timeloop("hist_cache[128 idx] gather",
+         lambda i: hist_cache[(idx + i) % L][0, 0, 0, 0])
+timeloop("hist_cache scatter 128",
+         lambda i: hist_cache.at[(idx + i) % L].set(0.5, mode="drop")[0, 0, 0, 0])
+
+hists = jnp.asarray(rng.rand(256, 3, F, B).astype(np.float32))
+sg = jnp.asarray(rng.rand(256).astype(np.float32))
+
+
+def dosearch(i):
+    hp = GrowConfig(
+        num_leaves=L, max_depth=0, min_data_in_leaf=20.0,
+        min_sum_hessian_in_leaf=1e-3, lambda_l1=0.0, lambda_l2=0.0,
+        max_delta_step=0.0, min_gain_to_split=0.0, path_smooth=0.0,
+        num_bins_padded=B).hp
+    r = jax.vmap(lambda h, a: find_best_split(h, a, a + 1.0, a + 100.0,
+                                              a * 0.0, meta, hp))(
+        hists + i * 1e-9, sg)
+    return r.gain[0]
+
+
+timeloop("vmap search 256 leaves", dosearch, n=10)
+
+# full tree with wave counter
+cfg = GrowConfig(
+    num_leaves=L, max_depth=0, min_data_in_leaf=20.0,
+    min_sum_hessian_in_leaf=1e-3, lambda_l1=0.0, lambda_l2=0.0,
+    max_delta_step=0.0, min_gain_to_split=0.0, path_smooth=0.0,
+    num_bins_padded=B, wave_gain_slack=0.4)
+
+# count waves by patching lax.while_loop around grow's internal use
+orig_while = jax.lax.while_loop
+counts = {}
+
+
+def counting_while(cond, body, init):
+    def body2(cb):
+        c, st = cb
+        return c + 1, body(st)
+    def cond2(cb):
+        return cond(cb[1])
+    c, out = orig_while(cond2, body2, (jnp.asarray(0, jnp.int32), init))
+    counts["waves"] = c
+    return out
+
+
+@jax.jit
+def one_tree():
+    jax.lax.while_loop_orig = None
+    tree, lor = gw.grow_tree_wave(X_t, grad, hess, in_bag, meta, cfg)
+    return tree.num_leaves, counts.get("waves", jnp.asarray(-1))
+
+
+gw.jax.lax.while_loop = counting_while
+try:
+    nl, waves = jax.device_get(one_tree())
+finally:
+    gw.jax.lax.while_loop = orig_while
+print(f"tree grown: {int(nl)} leaves in {int(waves)} waves", flush=True)
+
+
+@jax.jit
+def five_trees():
+    def f(i, acc):
+        tree, lor = gw.grow_tree_wave(X_t, grad + i * 1e-9, hess, in_bag,
+                                      meta, cfg)
+        return acc + tree.leaf_value[0]
+    return jax.lax.fori_loop(0, 5, f, jnp.float32(0.0))
+
+
+float(np.asarray(five_trees()))
+t0 = time.perf_counter()
+float(np.asarray(five_trees()))
+t = time.perf_counter() - t0
+print(f"full tree: {(t - 0.09) / 5 * 1e3:.1f} ms", flush=True)
