@@ -74,10 +74,20 @@ def run_train(params: Dict[str, Any], cfg) -> None:
         valid_sets.append(_load_dataset_from_config(cfg, vp, train_set))
         valid_names.append(vp.rsplit("/", 1)[-1])
     init_model = cfg.input_model if cfg.input_model else None
+    callbacks = []
+    if cfg.snapshot_freq > 0:
+        # periodic snapshots (GBDT::Train, gbdt.cpp:259-263)
+        def _snapshot(env):
+            it = env.iteration + 1
+            if it % cfg.snapshot_freq == 0:
+                env.model.save_model(
+                    f"{cfg.output_model}.snapshot_iter_{it}")
+        callbacks.append(_snapshot)
     booster = engine_train(params, train_set,
                            num_boost_round=cfg.num_iterations,
                            valid_sets=valid_sets, valid_names=valid_names,
-                           init_model=init_model)
+                           init_model=init_model,
+                           callbacks=callbacks or None)
     booster.save_model(cfg.output_model)
     log_info(f"Finished training; model saved to {cfg.output_model}")
 

@@ -41,8 +41,9 @@ def train(
 
     booster = Booster(params=params, train_set=train_set)
     if init_model is not None:
-        log_warning("init_model continued training is not yet wired into "
-                    "train(); starting fresh")
+        booster._gbdt.load_init_model(
+            init_model._gbdt if isinstance(init_model, Booster)
+            else init_model)
 
     valid_sets = valid_sets or []
     valid_names = valid_names or []

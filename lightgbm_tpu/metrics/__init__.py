@@ -358,6 +358,73 @@ class KLDivMetric(Metric):
         return [(self.name, float(np.sum(kl * w) / self.sum_weights), False)]
 
 
+class AucMuMetric(Metric):
+    """Multi-class AUC-mu (reference: multiclass_metric.hpp:184, after
+    Kleiman & Page, pmlr v97). Pairwise class separability measured along
+    the partition-weight direction, averaged over class pairs."""
+    name = "auc_mu"
+    is_higher_better = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        nc = self.config.num_class
+        wspec = self.config.auc_mu_weights
+        if wspec:
+            if len(wspec) != nc * nc:
+                from ..utils.log import log_fatal
+                log_fatal(f"auc_mu_weights must have {nc * nc} elements")
+            self._cw = np.asarray(wspec, np.float64).reshape(nc, nc)
+            np.fill_diagonal(self._cw, 0.0)
+        else:
+            self._cw = np.ones((nc, nc)) - np.eye(nc)
+
+    def eval(self, score, objective) -> List[MetricResult]:
+        nc = self.config.num_class
+        s = np.asarray(score, np.float64).reshape(nc, -1)
+        lab = self.label.astype(np.int64)
+        w = self.weight
+        ans = 0.0
+        eps = 1e-15
+        for i in range(nc):
+            for j in range(i + 1, nc):
+                curr_v = self._cw[i] - self._cw[j]
+                t1 = curr_v[i] - curr_v[j]
+                sel = (lab == i) | (lab == j)
+                idx = np.flatnonzero(sel)
+                va = t1 * (curr_v @ s[:, idx])
+                # sort by distance; ties put class j first (higher label).
+                # Within a tie group all j rows therefore precede all i
+                # rows, so the reference's sequential 0.5-credit rule is
+                # equivalent to: each i row counts the j weight of all
+                # groups up to its own, minus half its own group's.
+                order = np.lexsort((-lab[idx], va))
+                a = idx[order]
+                dist = va[order]
+                is_i = lab[a] == i
+                wt = np.ones(len(a)) if w is None else \
+                    np.asarray(w, np.float64)[a]
+                grp = np.zeros(len(a), np.int64)
+                if len(a) > 1:
+                    grp[1:] = np.cumsum(np.abs(np.diff(dist)) >= eps)
+                jw = np.where(is_i, 0.0, wt)
+                j_in = np.bincount(grp, weights=jw)
+                j_incl = np.cumsum(j_in)
+                sij = float(np.sum(
+                    wt[is_i] * (j_incl[grp[is_i]]
+                                - 0.5 * j_in[grp[is_i]])))
+                if w is None:
+                    ci = float(np.sum(lab == i))
+                    cj = float(np.sum(lab == j))
+                else:
+                    ww = np.asarray(w, np.float64)
+                    ci = float(np.sum(ww[lab == i]))
+                    cj = float(np.sum(ww[lab == j]))
+                if ci > 0 and cj > 0:
+                    ans += (sij / ci) / cj
+        ans = (2.0 * ans / nc) / (nc - 1)
+        return [(self.name, float(ans), True)]
+
+
 _METRIC_REGISTRY = {
     "l2": L2Metric, "mean_squared_error": L2Metric, "mse": L2Metric,
     "regression": L2Metric, "regression_l2": L2Metric,
@@ -377,6 +444,7 @@ _METRIC_REGISTRY = {
     "binary_logloss": BinaryLoglossMetric, "binary": BinaryLoglossMetric,
     "binary_error": BinaryErrorMetric,
     "auc": AUCMetric,
+    "auc_mu": AucMuMetric,
     "average_precision": AveragePrecisionMetric,
     "multi_logloss": MultiLoglossMetric, "multiclass": MultiLoglossMetric,
     "softmax": MultiLoglossMetric, "multiclassova": MultiLoglossMetric,

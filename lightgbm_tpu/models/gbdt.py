@@ -32,6 +32,7 @@ from ..ops.grow import DeviceTree, GrowConfig, grow_tree
 from ..ops.predict import predict_leaf_binned
 from ..ops.split import FeatureMeta
 from ..utils.log import log_fatal, log_info, log_warning
+from ..utils.timer import global_timer
 from .tree import Tree, make_decision_type
 
 _KEPS = 1e-15
@@ -41,12 +42,56 @@ MODEL_VERSION = "v4"
 from ..utils import round_up as _round_up
 
 
-def build_feature_meta(ds: BinnedDataset) -> FeatureMeta:
+def _parse_interaction_constraints(spec) -> List[List[int]]:
+    """'[0,1,2],[2,3]' or a list of lists -> list of real-index groups
+    (reference: config.h interaction_constraints)."""
+    if not spec:
+        return []
+    if isinstance(spec, str):
+        import re
+        return [[int(x) for x in grp.split(",") if x.strip() != ""]
+                for grp in re.findall(r"\[([^\]]*)\]", spec)]
+    return [list(map(int, grp)) for grp in spec]
+
+
+def build_feature_meta(ds: BinnedDataset,
+                       monotone: Optional[Sequence[int]] = None,
+                       interactions=None) -> FeatureMeta:
+    from ..utils.log import log_fatal as _fatal
+    mono_arr = None
+    if monotone:
+        # config lists constraints by REAL feature index; map to the used
+        # (inner) features. The reference Log::Fatals on a size mismatch
+        # (config.cpp CheckParamConflict) — same here, no silent drops.
+        if len(monotone) != ds.num_total_features:
+            _fatal(f"monotone_constraints has {len(monotone)} entries but "
+                   f"the dataset has {ds.num_total_features} features")
+        mono = np.zeros(len(ds.mappers), np.int8)
+        for inner, real in enumerate(ds.real_feature_index):
+            mono[inner] = np.sign(monotone[real])
+        if mono.any():
+            mono_arr = jnp.asarray(mono)
+    inter_arr = None
+    groups = _parse_interaction_constraints(interactions)
+    if groups:
+        real2inner = {r: i for i, r in enumerate(ds.real_feature_index)}
+        sets = np.zeros((len(groups), len(ds.mappers)), bool)
+        for s, grp in enumerate(groups):
+            for real in grp:
+                if real >= ds.num_total_features or real < 0:
+                    _fatal(f"interaction_constraints references feature "
+                           f"{real}, but the dataset has "
+                           f"{ds.num_total_features} features")
+                if real in real2inner:   # unused (trivial) features are
+                    sets[s, real2inner[real]] = True  # legitimately absent
+        inter_arr = jnp.asarray(sets)
     return FeatureMeta(
         num_bins=jnp.asarray(ds.feature_num_bins()),
         missing_type=jnp.asarray(ds.feature_missing_types()),
         default_bin=jnp.asarray(ds.feature_default_bins()),
         is_categorical=jnp.asarray(ds.feature_is_categorical()),
+        monotone=mono_arr,
+        inter_sets=inter_arr,
     )
 
 
@@ -129,7 +174,13 @@ class GBDT:
         if self.N_pad != N_real:
             Xt_np = np.pad(Xt_np, ((0, 0), (0, self.N_pad - N_real)))
         self.X_t = self._put_rows(jnp.asarray(Xt_np), row_axis=1)
-        self.meta = build_feature_meta(ds)
+        self.meta = build_feature_meta(ds, cfg.monotone_constraints,
+                                       cfg.interaction_constraints)
+        if self.meta.monotone is not None \
+                and cfg.monotone_constraints_method not in ("basic",):
+            log_warning("monotone_constraints_method="
+                        f"{cfg.monotone_constraints_method} is not "
+                        "implemented; using the 'basic' method")
         self.grow_cfg = GrowConfig(
             num_leaves=cfg.num_leaves,
             max_depth=cfg.max_depth,
@@ -152,6 +203,10 @@ class GBDT:
             # slack >= 1 would block the top ready leaf forever (device
             # while_loop livelock); clamp below 1
             wave_gain_slack=min(max(cfg.tpu_wave_gain_slack, 0.0), 0.99),
+            use_quantized_grad=cfg.use_quantized_grad,
+            num_grad_quant_bins=cfg.num_grad_quant_bins,
+            stochastic_rounding=cfg.stochastic_rounding,
+            quant_renew_leaf=cfg.quant_train_renew_leaf,
         )
 
         # grower selection: "wave" (default via auto) applies batched
@@ -178,6 +233,31 @@ class GBDT:
             self.grower = "compact"
         else:
             self.grower = "masked"
+        if cfg.use_quantized_grad and self.grower not in ("wave",
+                                                          "wave_exact"):
+            log_warning("use_quantized_grad is implemented by the wave "
+                        "grower; switching tpu_grower to 'wave'")
+            self.grower = "wave"
+        if (self.meta.monotone is not None
+                or self.meta.inter_sets is not None) \
+                and self.grower not in ("wave", "wave_exact"):
+            log_warning("monotone/interaction constraints are implemented "
+                        "by the wave grower; switching tpu_grower to "
+                        "'wave'")
+            self.grower = "wave"
+        # no silently-ignored parameters: fail loudly on parsed-but-
+        # unimplemented features (cf. VERDICT: silent drops are worse
+        # than absence)
+        if cfg.linear_tree:
+            log_fatal("linear_tree is not implemented in lightgbm_tpu yet")
+        if cfg.forcedsplits_filename:
+            log_fatal("forcedsplits_filename is not implemented in "
+                      "lightgbm_tpu yet")
+        if cfg.cegb_tradeoff != 1.0 or cfg.cegb_penalty_split != 0.0 \
+                or cfg.cegb_penalty_feature_lazy \
+                or cfg.cegb_penalty_feature_coupled:
+            log_fatal("cegb_* (cost-effective gradient boosting) is not "
+                      "implemented in lightgbm_tpu yet")
 
         K = self.num_tree_per_iteration
         N = self.num_data
@@ -238,16 +318,20 @@ class GBDT:
         else:
             grow_fn = grow_tree
 
+        takes_seed = self.grower in ("wave", "wave_exact")
         if self.use_dist:
             from ..parallel import build_data_parallel_train_fn
             self._train_tree = build_data_parallel_train_fn(
                 self.mesh, meta, cfg_static, grow_fn=grow_fn)
         else:
             @jax.jit
-            def train_tree(X_t, grad, hess, in_bag, scores_k, lr, feat_mask):
+            def train_tree(X_t, grad, hess, in_bag, scores_k, lr,
+                           feat_mask, seed):
+                kw = dict(feature_mask=feat_mask)
+                if takes_seed:
+                    kw["rng_seed"] = seed
                 tree, leaf_of_row = grow_fn(
-                    X_t, grad, hess, in_bag, meta, cfg_static,
-                    feature_mask=feat_mask)
+                    X_t, grad, hess, in_bag, meta, cfg_static, **kw)
                 leaf_shrunk = tree.leaf_value * lr
                 new_scores = scores_k + leaf_shrunk[leaf_of_row]
                 return tree, leaf_of_row, new_scores
@@ -320,21 +404,22 @@ class GBDT:
         # one batched transfer for all pending trees (one host sync).
         # Records are either a single DeviceTree (bias: float) or a chunk
         # of trees stacked [n, K, ...] (bias: list, iteration-major).
-        hosts = jax.device_get([t for t, _ in pending])
-        for host, (_, bias) in zip(hosts, pending):
-            if isinstance(bias, list):
-                flat = [jax.tree.map(
-                    lambda a, i=i, k=k: a[i, k], host)
-                    for i in range(host.num_leaves.shape[0])
-                    for k in range(host.num_leaves.shape[1])]
-            else:
-                flat = [host]
-                bias = [bias]
-            for h, b in zip(flat, bias):
-                tree = self._device_tree_to_host(h)
-                if abs(b) > _KEPS:
-                    tree.add_bias(b)
-                self._models.append(tree)
+        with global_timer.section("GBDT::MaterializeModels"):
+            hosts = jax.device_get([t for t, _ in pending])
+            for host, (_, bias) in zip(hosts, pending):
+                if isinstance(bias, list):
+                    flat = [jax.tree.map(
+                        lambda a, i=i, k=k: a[i, k], host)
+                        for i in range(host.num_leaves.shape[0])
+                        for k in range(host.num_leaves.shape[1])]
+                else:
+                    flat = [host]
+                    bias = [bias]
+                for h, b in zip(flat, bias):
+                    tree = self._device_tree_to_host(h)
+                    if abs(b) > _KEPS:
+                        tree.add_bias(b)
+                    self._models.append(tree)
 
     def _check_stopped(self) -> bool:
         """Fetch the pending trees' leaf counts (one sync) and report
@@ -399,6 +484,8 @@ class GBDT:
             return False          # DART/RF override per-iter behavior
         if self.objective is None or self.objective.runs_on_host:
             return False
+        if self.objective.need_renew_tree_output:
+            return False          # leaf renewal is a per-iteration host op
         if self.valid_sets:
             return False          # valid-score replay is per-iteration
         if any(self.sample_strategy.resamples_at(self.iter + i)
@@ -431,10 +518,15 @@ class GBDT:
             for m in (self._feature_mask_for_iter(self.iter + i)
                       for i in range(n))])
 
+        base_seed = self.config.seed or 0
+        seeds_dev = jnp.arange(self.iter, self.iter + n,
+                               dtype=jnp.int32) + base_seed
         scan_fn = self._get_scan_fn(n)
-        new_scores, tree_stack = scan_fn(
+        with global_timer.section("GBDT::TrainItersBatched/scan"):
+            new_scores, tree_stack = scan_fn(
             self.X_t, self.scores, self.label_dev, self.weight_dev,
-            self._in_bag_dev, jnp.float32(self.shrinkage_rate), masks_dev)
+            self._in_bag_dev, jnp.float32(self.shrinkage_rate), masks_dev,
+            seeds_dev)
         self.scores = new_scores
         # ONE stacked pending record for the whole chunk (slicing happens
         # host-side at materialization — per-tree device slices would
@@ -458,8 +550,9 @@ class GBDT:
         train_tree = self._train_tree
 
         @jax.jit
-        def scan_fn(X_t, scores0, label, weight, in_bag, lr, masks):
-            def step(scores, mask):
+        def scan_fn(X_t, scores0, label, weight, in_bag, lr, masks, seeds):
+            def step(scores, xs):
+                mask, seed = xs
                 if K == 1:
                     g, h = obj.get_gradients(scores[0], label, weight)
                     g, h = g[None, :], h[None, :]
@@ -470,13 +563,13 @@ class GBDT:
                     tree, _, ns = train_tree(
                         X_t, g[k], h[k],
                         in_bag if in_bag.ndim == 1 else in_bag[k],
-                        scores[k], lr, mask)
+                        scores[k], lr, mask, seed * K + k)
                     scores = scores.at[k].set(ns)
                     trees.append(tree)
                 stacked = jax.tree.map(lambda *a: jnp.stack(a), *trees)
                 return scores, stacked
 
-            return jax.lax.scan(step, scores0, masks)
+            return jax.lax.scan(step, scores0, (masks, seeds))
 
         cache[key] = scan_fn
         return scan_fn
@@ -519,11 +612,18 @@ class GBDT:
 
         lr = jnp.float32(self.shrinkage_rate)
         feat_mask = self._feature_mask_for_iter()
+        base_seed = self.config.seed or 0
         for k in range(K):
+          with global_timer.section("GBDT::TrainOneIter/grow"):
             tree_dev, leaf_of_row, new_scores = self._train_tree(
                 self.X_t, g_dev[k], h_dev[k],
                 in_bag if in_bag.ndim == 1 else in_bag[k],
-                self.scores[k], lr, feat_mask)
+                self.scores[k], lr, feat_mask,
+                jnp.int32((base_seed + self.iter) * K + k))
+            if (self.objective is not None
+                    and self.objective.need_renew_tree_output):
+                tree_dev, new_scores = self._renew_tree_output(
+                    k, tree_dev, leaf_of_row, lr)
             self.scores = self.scores.at[k].set(new_scores)
             # valid scores update BEFORE the bias fold: scorers received the
             # init score separately in _boost_from_average (the reference
@@ -558,6 +658,117 @@ class GBDT:
             self._stopped = self._check_stopped()
             return self._stopped
         return False
+
+    def load_init_model(self, init) -> None:
+        """Continued training from an existing model (reference:
+        engine.py:234-242 -> CreateBoosting(file), boosting.cpp:70-90):
+        adopt the trees and replay their outputs onto the training scores.
+        `init` is a GBDT instance or a model-file path/string."""
+        if isinstance(init, str):
+            import os
+            s = open(init).read() if os.path.exists(init) else init
+            init = GBDT.load_model_from_string(s, self.config)
+        import copy as _copy
+        trees = [_copy.deepcopy(t) for t in init.models]
+        if not trees:
+            return
+        K = self.num_tree_per_iteration
+        Xb = np.asarray(jax.device_get(self.X_t)).T[:self.num_data]
+        add = np.zeros((K, self.num_data), np.float32)
+        for i, tree in enumerate(trees):
+            self._ensure_binned_traversal(tree)
+            leaf = tree.get_leaf_binned(Xb, self)
+            add[i % K] += tree.leaf_value[leaf].astype(np.float32)
+        if self.N_pad != self.num_data:
+            add = np.pad(add, ((0, 0), (0, self.N_pad - self.num_data)))
+        self.scores = self.scores + self._put_rows(jnp.asarray(add),
+                                                   row_axis=1)
+        self._models = trees + self._models
+        self.iter = len(trees) // max(K, 1) + self.iter
+        log_info(f"Continued training from {len(trees)} existing trees")
+
+    def _ensure_binned_traversal(self, tree: Tree) -> None:
+        """File-loaded trees carry real-valued thresholds; derive the
+        training-time binned attributes (inner feature ids, bin
+        thresholds, bin bitsets) so they can be replayed over the binned
+        matrix (continued training / DART replay)."""
+        if getattr(tree, "split_feature_inner", None) is not None:
+            return
+        real2inner = {r: i for i, r in enumerate(self.real_feature_index)}
+        m = max(tree.num_leaves - 1, 0)
+        inner = np.zeros(m, np.int32)
+        thr_bin = np.zeros(m, np.int32)
+        is_cat = np.zeros(m, bool)
+        W = max((self.num_bins_padded + 31) // 32, 1)
+        bits = np.zeros((m, W), np.uint32)
+        for i in range(m):
+            real = int(tree.split_feature[i])
+            if real not in real2inner:
+                log_fatal(
+                    f"init_model splits on feature {real} which is unused "
+                    "(trivial/constant) in the current training data; "
+                    "continued training requires compatible features")
+            fi = real2inner[real]
+            inner[i] = fi
+            mp = self.mappers[fi]
+            if tree.num_cat > 0 and (int(tree.decision_type[i]) & 1):
+                is_cat[i] = True
+                ci = int(tree.threshold[i])   # cat splits store cat_idx
+                thr_bin[i] = ci
+                s0 = int(tree.cat_boundaries[ci])
+                s1 = int(tree.cat_boundaries[ci + 1])
+                words = np.asarray(tree.cat_threshold[s0:s1], np.uint32)
+                for b in range(min(mp.num_bin, 32 * W)):
+                    v = mp.bin_2_categorical[b] \
+                        if b < len(mp.bin_2_categorical) else -1
+                    if 0 <= v < 32 * len(words) and \
+                            (words[v >> 5] >> (v & 31)) & 1:
+                        bits[i, b >> 5] |= np.uint32(1 << (b & 31))
+            else:
+                thr_bin[i] = int(mp.value_to_bin(
+                    np.asarray([tree.threshold[i]]))[0])
+        tree.split_feature_inner = inner
+        tree.threshold_in_bin = thr_bin
+        tree.split_is_cat = is_cat
+        tree.split_cat_bitset_bins = bits
+
+    def _renew_tree_output(self, k: int, tree_dev, leaf_of_row, lr):
+        """Leaf-output renewal for l1/quantile/mape: replace each leaf's
+        value with the objective's percentile of the leaf's residuals
+        (reference: RenewTreeOutput, objective_function.h:58, applied at
+        serial_tree_learner.cpp:928-966 BEFORE shrinkage/score update).
+        Host computation: percentiles need per-leaf sorts; costs one
+        device readback per iteration for these objectives."""
+        alpha = self.objective.renew_tree_output_quantile()
+        if alpha is None:
+            return tree_dev, self.scores[k] + (
+                tree_dev.leaf_value * lr)[leaf_of_row]
+        N = self.num_data
+        lor, s_prev, lv, nl, inb = jax.device_get(
+            (leaf_of_row, self.scores[k], tree_dev.leaf_value,
+             tree_dev.num_leaves, self._in_bag_dev))
+        lor = np.asarray(lor)[:N]
+        s_prev = np.asarray(s_prev, np.float64)[:N]
+        leaf_vals = np.asarray(lv, np.float64).copy()
+        inb = np.asarray(inb)
+        inb = (inb[k] if inb.ndim > 1 else inb)[:N] > 0
+        label = np.asarray(self.objective.label, np.float64)
+        resid = label - s_prev
+        w = self.objective.renew_sample_weights()
+        from ..objectives import percentile_ref, weighted_percentile_ref
+        for leaf in range(int(nl)):
+            m = inb & (lor == leaf)
+            if not m.any():
+                continue
+            if w is None:
+                leaf_vals[leaf] = percentile_ref(resid[m], alpha)
+            else:
+                leaf_vals[leaf] = weighted_percentile_ref(
+                    resid[m], w[:N][m], alpha)
+        lv_new = jnp.asarray(leaf_vals, jnp.float32)
+        tree_dev = tree_dev._replace(leaf_value=lv_new)
+        new_scores = self.scores[k] + (lv_new * lr)[leaf_of_row]
+        return tree_dev, new_scores
 
     def _boost_from_average(self) -> np.ndarray:
         """gbdt.cpp:328: initial score from the objective's average."""

@@ -63,6 +63,13 @@ class ObjectiveFunction:
         """Percentile (alpha) for leaf-output renewal, or None."""
         return None
 
+    def renew_sample_weights(self) -> Optional[np.ndarray]:
+        """Per-row weights for leaf-output renewal percentiles (None =
+        unweighted). MAPE overrides with its label weights
+        (regression_objective.hpp RegressionMAPELOSS::RenewTreeOutput)."""
+        return None if self.weight is None \
+            else np.asarray(self.weight, np.float64)
+
     def to_string(self) -> str:
         return self.name
 
@@ -111,8 +118,9 @@ class RegressionL1(RegressionL2):
     def boost_from_score(self, class_id: int) -> float:
         if not self.config.boost_from_average or self.label is None:
             return 0.0
-        w, _ = self._w()
-        return _weighted_percentile(self.label, w, 0.5)
+        if self.weight is None:
+            return percentile_ref(self.label, 0.5)
+        return weighted_percentile_ref(self.label, self.weight, 0.5)
 
     def renew_tree_output_quantile(self):
         return 0.5
@@ -191,8 +199,10 @@ class RegressionQuantile(RegressionL2):
     def boost_from_score(self, class_id: int) -> float:
         if not self.config.boost_from_average or self.label is None:
             return 0.0
-        w, _ = self._w()
-        return _weighted_percentile(self.label, w, self.config.alpha)
+        if self.weight is None:
+            return percentile_ref(self.label, self.config.alpha)
+        return weighted_percentile_ref(self.label, self.weight,
+                                       self.config.alpha)
 
     def renew_tree_output_quantile(self):
         return self.config.alpha
@@ -220,11 +230,14 @@ class RegressionMAPE(RegressionL2):
     def boost_from_score(self, class_id: int) -> float:
         if not self.config.boost_from_average or self.label is None:
             return 0.0
-        return _weighted_percentile(
+        return weighted_percentile_ref(
             self.label, self._label_weight.astype(np.float64), 0.5)
 
     def renew_tree_output_quantile(self):
         return 0.5
+
+    def renew_sample_weights(self):
+        return np.asarray(self._label_weight, np.float64)
 
 
 class RegressionGamma(RegressionPoisson):
@@ -484,19 +497,48 @@ class CrossEntropyLambda(ObjectiveFunction):
         return "cross_entropy_lambda"
 
 
-def _weighted_percentile(values: np.ndarray, weights: np.ndarray,
-                         alpha: float) -> float:
-    """reference: regression_objective.hpp PercentileFun /
-    WeightedPercentileFun (:25-70)."""
-    order = np.argsort(values, kind="stable")
+def percentile_ref(values: np.ndarray, alpha: float) -> float:
+    """Exact reference percentile (PercentileFun,
+    regression_objective.hpp:25): descending order with linear
+    interpolation at (cnt-1)*(1-alpha)."""
+    cnt = len(values)
+    if cnt == 0:
+        return 0.0
+    if cnt == 1:
+        return float(values[0])
+    d = np.sort(np.asarray(values, np.float64))[::-1]
+    float_pos = (cnt - 1) * (1.0 - alpha)
+    pos = int(float_pos) + 1
+    if pos < 1:
+        return float(d[0])
+    if pos >= cnt:
+        return float(d[-1])
+    bias = float_pos - (pos - 1)
+    return float(d[pos - 1] - (d[pos - 1] - d[pos]) * bias)
+
+
+def weighted_percentile_ref(values: np.ndarray, weights: np.ndarray,
+                            alpha: float) -> float:
+    """Exact reference weighted percentile (WeightedPercentileFun,
+    regression_objective.hpp:57)."""
+    cnt = len(values)
+    if cnt == 0:
+        return 0.0
+    if cnt == 1:
+        return float(values[0])
+    order = np.argsort(np.asarray(values, np.float64), kind="stable")
     v = np.asarray(values, np.float64)[order]
     w = np.asarray(weights, np.float64)[order]
-    cum = np.cumsum(w) - 0.5 * w
-    total = np.sum(w)
-    if total <= 0:
-        return 0.0
-    q = cum / total
-    return float(np.interp(alpha, q, v))
+    cdf = np.cumsum(w)
+    thr = cdf[-1] * alpha
+    pos = int(np.searchsorted(cdf, thr, side="right"))
+    pos = min(pos, cnt - 1)
+    if pos == 0 or pos == cnt - 1:
+        return float(v[pos])
+    if cdf[pos] - cdf[pos - 1] >= 1.0:
+        return float((thr - cdf[pos - 1]) / (cdf[pos] - cdf[pos - 1])
+                     * (v[pos] - v[pos - 1]) + v[pos - 1])
+    return float(v[pos - 1])
 
 
 _OBJECTIVE_REGISTRY = {

@@ -47,9 +47,16 @@ class CatConfig(NamedTuple):
     num_bitset_words: int       # W: ceil(num_bins_padded / 32)
 
 
-def _gain_and_outputs(lg, lh, lc, rg, rh, rc, hp, parent_output):
+def _gain_and_outputs(lg, lh, lc, rg, rh, rc, hp, parent_output,
+                      leaf_min=None, leaf_max=None):
     lout = leaf_output(lg, lh, hp, lc, parent_output)
     rout = leaf_output(rg, rh, hp, rc, parent_output)
+    if leaf_min is not None:
+        # monotone ancestors bound every descendant's output, categorical
+        # splits included (the direction rule itself only applies to
+        # numerical splits)
+        lout = jnp.clip(lout, leaf_min, leaf_max)
+        rout = jnp.clip(rout, leaf_min, leaf_max)
     gain = (leaf_gain_given_output(lg, lh, hp, lout)
             + leaf_gain_given_output(rg, rh, hp, rout))
     return gain, lout, rout
@@ -65,6 +72,8 @@ def find_best_split_categorical(
     hp: SplitHyperParams,
     cat: CatConfig,
     feature_mask: jnp.ndarray | None = None,
+    leaf_min: jnp.ndarray | None = None,
+    leaf_max: jnp.ndarray | None = None,
 ) -> tuple[SplitResult, jnp.ndarray]:
     """Best categorical split over all features for one leaf.
 
@@ -106,7 +115,8 @@ def find_best_split_categorical(
     rg1, rh1, rc1 = (parent[0] - lg1, parent[1] - lh1 - _EPS,
                      parent[2] - lc1)
     gain1, lout1, rout1 = _gain_and_outputs(lg1, lh1, lc1, rg1, rh1, rc1,
-                                            hp, parent_output)
+                                            hp, parent_output,
+                                            leaf_min, leaf_max)
     ok1 = valid & onehot_f & constraints_ok(lh1, lc1, rh1, rc1)
     gain1 = jnp.where(ok1 & (gain1 > min_gain_shift), gain1, NEG_INF)
 
@@ -129,7 +139,8 @@ def find_best_split_categorical(
         rg, rh, rc = (parent[0] - lg, parent[1] - lh - _EPS,
                       parent[2] - lc)
         gain, lout, rout = _gain_and_outputs(lg, lh, lc, rg, rh, rc,
-                                             hp_cat, parent_output)
+                                             hp_cat, parent_output,
+                                             leaf_min, leaf_max)
         pos = bins                                            # prefix length-1
         ok = ((pos < jnp.minimum(used_bin, max_num_cat)[:, None])
               & ~onehot_f & is_cat[:, None]

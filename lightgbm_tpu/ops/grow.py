@@ -73,6 +73,13 @@ class GrowConfig(NamedTuple):
     # higher values approach strict leaf-wise order at the cost of more
     # waves
     wave_gain_slack: float = 0.0
+    # quantized-gradient training (reference: gradient_discretizer.cpp,
+    # config.h:627-646): int8 grad/hess with per-tree scales + stochastic
+    # rounding, exact int32 histograms on the int8 MXU path
+    use_quantized_grad: bool = False
+    num_grad_quant_bins: int = 4
+    stochastic_rounding: bool = True
+    quant_renew_leaf: bool = False
 
     @property
     def hp(self) -> SplitHyperParams:

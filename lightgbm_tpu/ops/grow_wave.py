@@ -29,9 +29,13 @@ split mathematics, identical best-first (leaf-wise) order — into batched
      gather, no scatter, no order permutation).
 
 Order semantics by mode:
-  * wave_exact=True: trees IDENTICAL to the serial growers' (same priority
-    queue as serial_tree_learner.cpp:222; argmax ties by index); only the
-    schedule of device work differs. Cost: ~O(priority-chain) waves.
+  * wave_exact=True: same priority-queue order as the serial growers
+    (serial_tree_learner.cpp:222; argmax ties by index); only the schedule
+    of device work differs. Per-bin counts are synthesized from hessians
+    with the parent count/hessian ratio (the reference's own cnt_factor
+    approximation, feature_histogram.hpp:877), so min_data_in_leaf
+    decisions and count metadata are approximate where the serial growers
+    carry exact counts. Cost: ~O(priority-chain) waves.
   * wave_exact=False (default): each wave applies EVERY ready leaf whose
     gain >= wave_gain_slack * (best frontier gain), in gain order — a
     gain-prioritized batched frontier that approaches strict leaf-wise as
@@ -69,6 +73,25 @@ def _wave_buckets(L: int) -> list[int]:
     return [k for k in (8, 32) if k < kmax] + [kmax]
 
 
+def _oh_dot(oh: jnp.ndarray, flat: jnp.ndarray) -> jnp.ndarray:
+    """[K, L] one-hot (f32) times [L, D] values; exact for f32 tables and
+    for int32 tables (via two 16-bit planes). Precision.HIGHEST is
+    REQUIRED: the TPU default runs f32 matmuls as bf16 passes, which
+    rounds the 'exact' one-hot products to 8 mantissa bits."""
+    dims = (((1,), (0,)), ((), ()))
+    hp_ = jax.lax.Precision.HIGHEST
+    if flat.dtype == jnp.int32:
+        hi = jax.lax.shift_right_arithmetic(flat, 16).astype(jnp.float32)
+        lo = (flat & 0xFFFF).astype(jnp.float32)
+        ohi = jax.lax.dot_general(oh, hi, dims, precision=hp_,
+                                  preferred_element_type=jnp.float32)
+        olo = jax.lax.dot_general(oh, lo, dims, precision=hp_,
+                                  preferred_element_type=jnp.float32)
+        return ohi.astype(jnp.int32) * 65536 + olo.astype(jnp.int32)
+    return jax.lax.dot_general(oh, flat, dims, precision=hp_,
+                               preferred_element_type=jnp.float32)
+
+
 def _onehot_gather(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     """table [L, ...] gathered at idx [K] -> [K, ...] via a one-hot matmul.
 
@@ -79,9 +102,7 @@ def _onehot_gather(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     L = table.shape[0]
     oh = (idx[:, None] == jnp.arange(L, dtype=idx.dtype)[None, :]
           ).astype(jnp.float32)                              # [K, L]
-    flat = table.reshape(L, -1)
-    out = jax.lax.dot_general(oh, flat, (((1,), (0,)), ((), ())),
-                              preferred_element_type=jnp.float32)
+    out = _oh_dot(oh, table.reshape(L, -1))
     return out.reshape((idx.shape[0],) + table.shape[1:])
 
 
@@ -93,11 +114,9 @@ def _onehot_scatter(table: jnp.ndarray, idx: jnp.ndarray,
     L = table.shape[0]
     oh = (idx[:, None] == jnp.arange(L, dtype=idx.dtype)[None, :]
           ).astype(jnp.float32)                              # [K, L]
-    keep = 1.0 - jnp.max(oh, axis=0)                         # [L]
-    add = jax.lax.dot_general(oh.T, rows.reshape(idx.shape[0], -1),
-                              (((1,), (0,)), ((), ())),
-                              preferred_element_type=jnp.float32)
-    flat = table.reshape(L, -1) * keep[:, None] + add
+    keep = (1.0 - jnp.max(oh, axis=0))                       # [L]
+    add = _oh_dot(oh.T, rows.reshape(idx.shape[0], -1))
+    flat = table.reshape(L, -1) * keep[:, None].astype(table.dtype) + add
     return flat.reshape(table.shape)
 
 
@@ -110,10 +129,14 @@ class _WaveState(NamedTuple):
     leaf_output: jnp.ndarray       # [L] f32
     leaf_sum_g: jnp.ndarray        # [L] f32
     leaf_sum_h: jnp.ndarray        # [L] f32
-    hist_cache: jnp.ndarray        # [L, 3, F, B] leaf's own histogram
-    small_hist: jnp.ndarray        # [L, 3, F, B] pending smaller-child hist
+    hist_cache: jnp.ndarray        # [L, 2, F, B] leaf's own histogram
+    #                                (f32, or exact int32 when quantized)
+    small_hist: jnp.ndarray        # [L, 2, F, B] pending smaller-child hist
     small_is_left: jnp.ndarray     # [L] bool: which child the above is
     ready: jnp.ndarray             # [L] bool: child hists + searches done
+    leaf_min: jnp.ndarray          # [L] f32 monotone output lower bound
+    leaf_max: jnp.ndarray          # [L] f32 monotone output upper bound
+    leaf_sets: jnp.ndarray         # [L, S] bool satisfiable interaction sets
     best: SplitResult              # [L] per-leaf best split
     best_is_cat: jnp.ndarray       # [L] bool
     best_bitset: jnp.ndarray       # [L, W] u32
@@ -146,6 +169,7 @@ def grow_tree_wave(
     cfg: GrowConfig,
     feature_mask: Optional[jnp.ndarray] = None,
     dist: Optional[object] = None,
+    rng_seed: Optional[jnp.ndarray] = None,
 ) -> tuple[DeviceTree, jnp.ndarray]:
     """Wave-pipelined exact leaf-wise growth; contract of grow.py:grow_tree."""
     F, N = X_t.shape
@@ -157,38 +181,123 @@ def grow_tree_wave(
     max_depth = cfg.max_depth if cfg.max_depth > 0 else 10**9
     buckets = _wave_buckets(L)
     KMAX = buckets[-1]
+    quant = cfg.use_quantized_grad
 
     def psum(x):
         return dist.psum(x) if dist is not None else x
 
+    def pmax(x):
+        return dist.pmax(x) if dist is not None else x
+
     g = grad.astype(jnp.float32) * in_bag
     h = hess.astype(jnp.float32) * in_bag
-    vals0 = jnp.stack([g, h, in_bag], axis=0)                # [3, N]
+    root_g = psum(jnp.sum(g))
+    root_h = psum(jnp.sum(h))
+    root_c = psum(jnp.sum(in_bag))
 
-    def search(hist, sum_g, sum_h, count, out):
+    # Histograms carry (grad, hess) only — per-bin counts are synthesized
+    # from hessians with the parent's count/hessian ratio at search time,
+    # exactly the reference's cnt_factor approximation
+    # (FindBestThresholdSequentially, feature_histogram.hpp:877).
+    if quant:
+        # GradientDiscretizer::DiscretizeGradients semantics
+        # (gradient_discretizer.cpp:72-162): per-tree scales synced by max
+        # across shards, trunc-toward-zero stochastic rounding to int8,
+        # exact int32 histogram accumulation.
+        qb = cfg.num_grad_quant_bins
+        max_g = pmax(jnp.max(jnp.abs(g)))
+        max_h = pmax(jnp.max(h))
+        g_scale = jnp.maximum(max_g / (qb // 2), 1e-30)
+        h_scale = jnp.maximum(max_h / qb, 1e-30)
+        if cfg.stochastic_rounding:
+            seed = rng_seed if rng_seed is not None else jnp.int32(0)
+            key = jax.random.PRNGKey(seed)
+            kg, kh = jax.random.split(key)
+            ug = jax.random.uniform(kg, (N,), jnp.float32)
+            uh = jax.random.uniform(kh, (N,), jnp.float32)
+        else:
+            ug = uh = jnp.float32(0.5)
+        g8 = jnp.clip(jnp.trunc(g / g_scale + jnp.sign(g) * ug),
+                      -127, 127).astype(jnp.int8)
+        h8 = jnp.clip(jnp.trunc(h / h_scale + uh), 0, 127).astype(jnp.int8)
+        vals0 = jnp.stack([g8, h8], axis=0)              # [2, N] int8
+        ch_scale = jnp.stack([g_scale, h_scale])[:, None, None]
+    else:
+        vals0 = jnp.stack([g, h], axis=0)                # [2, N] f32
+        ch_scale = None
+
+    def to_f32(hist2):
+        """Descale an int32 [2, F, B] histogram (no-op for f32 mode)."""
+        if quant:
+            return hist2.astype(jnp.float32) * ch_scale
+        return hist2
+
+    has_mono = meta.monotone is not None
+    has_inter = meta.inter_sets is not None
+    S = meta.inter_sets.shape[0] if has_inter else 1
+
+    def sets_to_fmask(sets_row):
+        """[S] bool active-constraint sets -> [F] bool allowed features,
+        combined with the global column-sampling mask (ColSampler with
+        interaction constraints, col_sampler.hpp:208)."""
+        m = jnp.any(meta.inter_sets & sets_row[:, None], axis=0)
+        return m if feature_mask is None else m & feature_mask
+
+    def search(hist2, sum_g, sum_h, count, out, bmin, bmax, sets_row):
+        hist2 = to_f32(hist2)
+        cntf = count / jnp.maximum(sum_h, 1e-12)
+        hist = jnp.concatenate([hist2, hist2[1:2] * cntf], axis=0)
+        fmask = sets_to_fmask(sets_row) if has_inter else feature_mask
         num = find_best_split(hist, sum_g, sum_h, count, out, meta, hp,
-                              feature_mask)
+                              fmask,
+                              leaf_min=bmin if has_mono else None,
+                              leaf_max=bmax if has_mono else None)
         if not cfg.has_categorical:
             return num, jnp.zeros((), bool), jnp.zeros((W,), jnp.uint32)
         catres, bitset = find_best_split_categorical(
-            hist, sum_g, sum_h, count, out, meta, hp, cfg.cat, feature_mask)
+            hist, sum_g, sum_h, count, out, meta, hp, cfg.cat, fmask,
+            leaf_min=bmin if has_mono else None,
+            leaf_max=bmax if has_mono else None)
         use_cat = catres.gain > num.gain
         merged = SplitResult(*[
             jnp.where(use_cat, cv, nv) for cv, nv in zip(catres, num)])
         return merged, use_cat, jnp.where(use_cat, bitset,
                                           jnp.zeros((W,), jnp.uint32))
 
+    def child_sets(bs, psets):
+        """Constraint sets still satisfiable in the children: the parent's
+        sets that contain the split feature (both children alike)."""
+        if not has_inter:
+            return psets
+        contains = jnp.take(meta.inter_sets.T, bs.feature, axis=0)  # [K, S]
+        return psets & contains
+
+    def child_bounds(bs, pmin, pmax):
+        """Children's monotone output bounds after a split (basic method,
+        BasicLeafConstraints::Update, monotone_constraints.hpp:330): on a
+        monotone feature the children are separated at the midpoint of
+        the (clamped) outputs."""
+        if not has_mono:
+            z = jnp.zeros_like(bs.gain)
+            return z, z, z, z
+        mono_f = meta.monotone[bs.feature]
+        mid = 0.5 * (bs.left_output + bs.right_output)
+        lmax = jnp.where(mono_f > 0, jnp.minimum(pmax, mid), pmax)
+        rmin = jnp.where(mono_f > 0, jnp.maximum(pmin, mid), pmin)
+        lmin = jnp.where(mono_f < 0, jnp.maximum(pmin, mid), pmin)
+        rmax = jnp.where(mono_f < 0, jnp.minimum(pmax, mid), pmax)
+        return lmin, lmax, rmin, rmax
+
     # ---- root
-    root_g = psum(jnp.sum(g))
-    root_h = psum(jnp.sum(h))
-    root_c = psum(jnp.sum(in_bag))
     root_out = jnp.asarray(
         -jnp.sign(root_g) * jnp.maximum(jnp.abs(root_g) - hp.lambda_l1, 0.0)
         / (root_h + hp.lambda_l2), jnp.float32)
 
     hist_root = psum(build_histogram(X_t, vals0, B, cfg.rows_per_chunk))
     root_split, root_is_cat, root_bitset = search(
-        hist_root, root_g, root_h, root_c, root_out)
+        hist_root, root_g, root_h, root_c, root_out,
+        jnp.float32(-jnp.inf), jnp.float32(jnp.inf),
+        jnp.ones((S,), bool))
     root_split = root_split._replace(
         gain=jnp.where(max_depth >= 1, root_split.gain, NEG_INF))
 
@@ -224,10 +333,14 @@ def grow_tree_wave(
         leaf_output=jnp.zeros((L,), jnp.float32).at[0].set(root_out),
         leaf_sum_g=jnp.zeros((L,), jnp.float32).at[0].set(root_g),
         leaf_sum_h=jnp.zeros((L,), jnp.float32).at[0].set(root_h),
-        hist_cache=jnp.zeros((L, 3, F, B), jnp.float32).at[0].set(hist_root),
-        small_hist=jnp.zeros((L, 3, F, B), jnp.float32),
+        hist_cache=jnp.zeros((L, 2, F, B),
+                             hist_root.dtype).at[0].set(hist_root),
+        small_hist=jnp.zeros((L, 2, F, B), hist_root.dtype),
         small_is_left=jnp.zeros((L,), bool),
         ready=jnp.zeros((L,), bool),
+        leaf_min=jnp.full((L,), -jnp.inf, jnp.float32),
+        leaf_max=jnp.full((L,), jnp.inf, jnp.float32),
+        leaf_sets=jnp.ones((L, S), bool),
         best=_set_cache(empty, 0, root_split, True),
         best_is_cat=jnp.zeros((L,), bool).at[0].set(root_is_cat),
         best_bitset=jnp.zeros((L, W), jnp.uint32).at[0].set(root_bitset),
@@ -423,7 +536,7 @@ def grow_tree_wave(
         # children own-histograms from the speculative pass + subtraction.
         # One-hot matmul gathers/scatters: XLA's dynamic gather runs ~2GB/s
         # here, while these read/write the 22MB caches at HBM speed.
-        hsm = _onehot_gather(st.small_hist, drop_p)          # [K, 3, F, B]
+        hsm = _onehot_gather(st.small_hist, drop_p)          # [K, 2, F, B]
         hlg = _onehot_gather(st.hist_cache, drop_p) - hsm
         sil = st.small_is_left[p_j][:, None, None, None]
         hcl = jnp.where(sil, hsm, hlg)
@@ -444,6 +557,12 @@ def grow_tree_wave(
         best_bitset = best_bitset.at[drop_r].set(
             st.bitsr[p_j], mode="drop")
         ready = upd2(st.ready, False, False)
+        almin, almax, armin, armax = child_bounds(
+            bs2, st.leaf_min[p_j], st.leaf_max[p_j])
+        leaf_min2 = upd2(st.leaf_min, almin, armin)
+        leaf_max2 = upd2(st.leaf_max, almax, armax)
+        asets = child_sets(bs2, st.leaf_sets[p_j])
+        leaf_sets2 = upd2(st.leaf_sets, asets, asets)
 
         st = st._replace(
             tree=t,
@@ -458,6 +577,8 @@ def grow_tree_wave(
             leaf_sum_g=upd2(st.leaf_sum_g, bs2.left_sum_g, bs2.right_sum_g),
             leaf_sum_h=upd2(st.leaf_sum_h, bs2.left_sum_h, bs2.right_sum_h),
             hist_cache=hist_cache, ready=ready,
+            leaf_min=leaf_min2, leaf_max=leaf_max2,
+            leaf_sets=leaf_sets2,
             best=best, best_is_cat=best_is_cat, best_bitset=best_bitset,
         )
 
@@ -505,7 +626,7 @@ def grow_tree_wave(
             hist_small = psum(jax.lax.switch(kidx, hist_branches,
                                              slot_small))
             hist_parent = _onehot_gather(
-                st.hist_cache, jnp.where(valid, cand, L))    # [K, 3, F, B]
+                st.hist_cache, jnp.where(valid, cand, L))    # [K, 2, F, B]
             hist_large = hist_parent - hist_small
             hist_l = jnp.where(smaller_is_left[:, None, None, None],
                                hist_small, hist_large)
@@ -518,8 +639,15 @@ def grow_tree_wave(
             sh_lr = jnp.concatenate([bs.left_sum_h, bs.right_sum_h])
             c_lr = jnp.concatenate([bs.left_count, bs.right_count])
             o_lr = jnp.concatenate([bs.left_output, bs.right_output])
-            s_lr, cat_lr, bits_lr = jax.vmap(search)(hist_lr, sg_lr, sh_lr,
-                                                     c_lr, o_lr)
+            clmin, clmax, crmin, crmax = child_bounds(
+                bs, st.leaf_min[cand], st.leaf_max[cand])
+            bmin_lr = jnp.concatenate([clmin, crmin])
+            bmax_lr = jnp.concatenate([clmax, crmax])
+            csets = child_sets(bs, st.leaf_sets[cand])       # [K, S]
+            sets_lr = jnp.concatenate([csets, csets], axis=0)
+            s_lr, cat_lr, bits_lr = jax.vmap(search)(
+                hist_lr, sg_lr, sh_lr, c_lr, o_lr, bmin_lr, bmax_lr,
+                sets_lr)
             # depth mask applied at store time so the order simulation can
             # use stored gains directly
             can = st.leaf_depth[cand] + 1 < max_depth
@@ -557,4 +685,32 @@ def grow_tree_wave(
     if L > 1:
         state = jax.lax.while_loop(cond, wave_step, state)
 
-    return state.tree, state.leaf_of_row
+    tree_out = state.tree
+    if quant and cfg.quant_renew_leaf and cfg.path_smooth <= 1e-15:
+        # RenewIntGradTreeOutput (gradient_discretizer.cpp:210): replace
+        # quantized leaf values with outputs from EXACT fp leaf sums —
+        # segment sums over leaf_of_row via the slot kernel on a dummy
+        # single-bin feature (all mass lands in bin 0)
+        from .split import threshold_l1
+        dummy = jnp.zeros((1, N), jnp.uint8)
+        fp2 = jnp.stack([g, h], axis=0)
+        sums = []
+        for off in range(0, L, KMAX):
+            sl = jnp.where((state.leaf_of_row >= off)
+                           & (state.leaf_of_row < off + KMAX),
+                           state.leaf_of_row - off, -1)
+            hs = psum(build_histogram_slots(dummy, fp2, sl, KMAX, 32,
+                                            cfg.rows_per_chunk))
+            sums.append(hs[:, :, 0, 0])                  # [KMAX, 2]
+        sums = jnp.concatenate(sums, axis=0)[:L]
+        sg, sh = sums[:, 0], sums[:, 1]
+        lv = -threshold_l1(sg, hp.lambda_l1) / (sh + hp.lambda_l2)
+        if hp.max_delta_step > 0:
+            lv = jnp.clip(lv, -hp.max_delta_step, hp.max_delta_step)
+        ok = (jnp.arange(L) < tree_out.num_leaves) & (sh > 0.0) \
+            & (tree_out.num_leaves > 1)
+        tree_out = tree_out._replace(
+            leaf_value=jnp.where(ok, lv.astype(jnp.float32),
+                                 tree_out.leaf_value))
+
+    return tree_out, state.leaf_of_row

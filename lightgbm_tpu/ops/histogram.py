@@ -93,10 +93,14 @@ def build_histogram_slots(
 
 def _build_histogram_xla(X_binned_t, vals, num_bins, rows_per_chunk=8192,
                          dtype=jnp.float32):
-    """Portable XLA lowering (also the pinned reference in kernel tests)."""
+    """Portable XLA lowering (also the pinned reference in kernel tests).
+    int8 `vals` accumulate exactly in int32 (quantized-gradient mode)."""
     F, N = X_binned_t.shape
     C = vals.shape[0]
     B = num_bins
+    if vals.dtype == jnp.int8:
+        dtype = jnp.int32
+    acc = jnp.int32 if dtype == jnp.int32 else jnp.float32
     chunk = min(rows_per_chunk, _round_up(N, 128))
     Np = _round_up(N, chunk)
     if Np != N:
@@ -113,10 +117,10 @@ def _build_histogram_xla(X_binned_t, vals, num_bins, rows_per_chunk=8192,
         onehot = (xb[:, :, None].astype(jnp.int32) == iota[None, None, :]
                   ).astype(dtype)                     # [F, R, B]
         part = jnp.einsum("frb,cr->cfb", onehot, vb.astype(dtype),
-                          preferred_element_type=jnp.float32)
+                          preferred_element_type=acc)
         return hist + part, None
 
-    hist0 = jnp.zeros((C, F, B), dtype=jnp.float32)
+    hist0 = jnp.zeros((C, F, B), dtype=acc)
     hist, _ = jax.lax.scan(body, hist0, (Xc, Vc))
     return hist
 
@@ -124,10 +128,13 @@ def _build_histogram_xla(X_binned_t, vals, num_bins, rows_per_chunk=8192,
 def _build_histogram_slots_xla(X_binned_t, vals, slot, num_slots, num_bins,
                                rows_per_chunk=8192):
     """Portable XLA wave lowering: one-hot over the combined (slot, bin)
-    index — the pinned reference for the Pallas wave kernel tests."""
+    index — the pinned reference for the Pallas wave kernel tests.
+    int8 `vals` accumulate exactly in int32 (quantized-gradient mode)."""
     F, N = X_binned_t.shape
     C = vals.shape[0]
     K, B = num_slots, num_bins
+    quantized = vals.dtype == jnp.int8
+    acc = jnp.int32 if quantized else jnp.float32
     chunk = min(rows_per_chunk, _round_up(N, 128))
     Np = _round_up(N, chunk)
     if Np != N:
@@ -145,13 +152,13 @@ def _build_histogram_slots_xla(X_binned_t, vals, slot, num_slots, num_bins,
     def body(hist, xs):
         xb, vb, sb = xs                               # [F,R], [C,R], [R]
         oh_bin = (xb[:, :, None].astype(jnp.int32) == iota_b[None, None, :]
-                  ).astype(jnp.float32)               # [F, R, B]
-        oh_slot = (sb[None, :] == iota_k[:, None]).astype(jnp.float32)
-        w = oh_slot[:, None, :] * vb[None, :, :]      # [K, C, R]
+                  ).astype(acc)                       # [F, R, B]
+        oh_slot = (sb[None, :] == iota_k[:, None]).astype(acc)
+        w = oh_slot[:, None, :] * vb[None, :, :].astype(acc)  # [K, C, R]
         part = jnp.einsum("frb,kcr->kcfb", oh_bin, w,
-                          preferred_element_type=jnp.float32)
+                          preferred_element_type=acc)
         return hist + part, None
 
-    hist0 = jnp.zeros((K, C, F, B), jnp.float32)
+    hist0 = jnp.zeros((K, C, F, B), acc)
     hist, _ = jax.lax.scan(body, hist0, (Xc, Vc, Sc))
     return hist

@@ -69,13 +69,18 @@ def _compute_dims(num_bins: int):
     return B, LO, HB
 
 
-def _slots_kernel(x_ref, v_ref, s_ref, out_ref, *, K, C, B, LO, HB):
+def _slots_kernel(x_ref, v_ref, s_ref, out_ref, *, K, C, B, LO, HB,
+                  quantized):
     """Grid (F_blocks, N_blocks); N varies fastest so out_ref stays resident.
 
-    x_ref  [F_BLK, R] int8      binned features
-    v_ref  [C, R]     f32       value channels (bag-masked)
-    s_ref  [1, R]     int32     slot id per row; outside [0, K) = inactive
-    out_ref[K, C, F_BLK, B] f32
+    x_ref  [F_BLK, R] int8          binned features
+    v_ref  [C, R]     f32 / int8    value channels (bag-masked)
+    s_ref  [1, R]     int32         slot id per row; outside [0, K) = none
+    out_ref[K, C, F_BLK, B] f32 / int32
+
+    quantized=True runs the contraction as s8 x s8 -> s32 on the MXU (the
+    int8 analog of the reference's discretized histogram kernels,
+    cuda_histogram_constructor.cu:253-527) — exact integer accumulation.
     """
     n = pl.program_id(1)
 
@@ -86,13 +91,15 @@ def _slots_kernel(x_ref, v_ref, s_ref, out_ref, *, K, C, B, LO, HB):
     R = v_ref.shape[1]
     sl = s_ref[0, :]                                       # [R] i32
     vals = v_ref[...]                                      # [C, R]
+    w_dtype = jnp.int8 if quantized else jnp.bfloat16
+    acc_dtype = jnp.int32 if quantized else jnp.float32
 
     # W [K*C, R]: slot-masked value channels — shared across all features
     w_rows = []
     for k in range(K):
-        mk = (sl == k).astype(jnp.float32)
-        w_rows.append(vals * mk[None, :])
-    W = jnp.concatenate(w_rows, axis=0).astype(jnp.bfloat16)   # [K*C, R]
+        mk = sl == k
+        w_rows.append(jnp.where(mk[None, :], vals, 0))
+    W = jnp.concatenate(w_rows, axis=0).astype(w_dtype)    # [K*C, R]
 
     lo_iota = jax.lax.broadcasted_iota(jnp.int32, (LO, R), 0)
 
@@ -100,20 +107,20 @@ def _slots_kernel(x_ref, v_ref, s_ref, out_ref, *, K, C, B, LO, HB):
         # int8 storage sign-extends bins >= 128; mask back to unsigned
         bins_f = x_ref[f, :].astype(jnp.int32) & 0xFF      # [R]
         lo = bins_f & (LO - 1)
-        oh_lo = (lo[None, :] == lo_iota).astype(jnp.bfloat16)   # [LO, R]
+        oh_lo = (lo[None, :] == lo_iota).astype(w_dtype)   # [LO, R]
         if HB == 1:
             # one MXU contraction per feature: [K*C, R] x [LO, R]^T
             part = jax.lax.dot_general(
                 W, oh_lo, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)        # [K*C, LO]
+                preferred_element_type=acc_dtype)          # [K*C, LO]
             out_ref[:, :, f, :] += part.reshape(K, C, B)
         else:
             hi = bins_f >> 7
             for hb in range(HB):
-                Whb = W * (hi[None, :] == hb).astype(jnp.bfloat16)
+                Whb = jnp.where((hi == hb)[None, :], W, 0)
                 part = jax.lax.dot_general(
                     Whb, oh_lo, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32)
+                    preferred_element_type=acc_dtype)
                 out_ref[:, :, f, hb * LO:(hb + 1) * LO] += \
                     part.reshape(K, C, LO)
 
@@ -122,16 +129,18 @@ def _slots_kernel(x_ref, v_ref, s_ref, out_ref, *, K, C, B, LO, HB):
                    static_argnames=("num_slots", "num_bins", "interpret"))
 def build_histogram_slots_pallas(
     X_binned_t: jnp.ndarray,   # [F, N] int8/uint8 (feature-major)
-    vals: jnp.ndarray,         # [C, N] f32 (bag-masked)
+    vals: jnp.ndarray,         # [C, N] f32 (bag-masked) or int8 (quantized)
     slot: jnp.ndarray,         # [N] int32
     num_slots: int,
     num_bins: int,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Wave histogram on TPU: returns [K, C, F, num_bins] float32."""
+    """Wave histogram on TPU: returns [K, C, F, num_bins] float32, or
+    int32 when `vals` is int8 (quantized-gradient training)."""
     F, N = X_binned_t.shape
     C = vals.shape[0]
     K = num_slots
+    quantized = vals.dtype == jnp.int8
     B, LO, HB = _compute_dims(num_bins)
     # the [K, C, f_blk, B] f32 out block is double-buffered across the
     # feature grid and must stay well inside scoped VMEM (16MB) next to the
@@ -146,14 +155,16 @@ def build_histogram_slots_pallas(
     X = X_binned_t.astype(jnp.int8)
     if Fp != F or Np != N:
         X = jnp.pad(X, ((0, Fp - F), (0, Np - N)))
-    v = vals.astype(jnp.float32)
+    v = vals if quantized else vals.astype(jnp.float32)
     s = slot.astype(jnp.int32)
     if Np != N:
         v = jnp.pad(v, ((0, 0), (0, Np - N)))
         s = jnp.pad(s, (0, Np - N), constant_values=-1)
 
+    out_dtype = jnp.int32 if quantized else jnp.float32
     grid = (Fp // f_blk, Np // n_blk)
-    kernel = functools.partial(_slots_kernel, K=K, C=C, B=B, LO=LO, HB=HB)
+    kernel = functools.partial(_slots_kernel, K=K, C=C, B=B, LO=LO, HB=HB,
+                               quantized=quantized)
     out = pl.pallas_call(
         kernel,
         grid=grid,
@@ -167,7 +178,7 @@ def build_histogram_slots_pallas(
         ],
         out_specs=pl.BlockSpec((K, C, f_blk, B), lambda f, n: (0, 0, f, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((K, C, Fp, B), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((K, C, Fp, B), out_dtype),
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
             flops=2 * K * C * Fp * Np * B,

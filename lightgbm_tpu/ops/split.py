@@ -29,7 +29,7 @@ Direction semantics (feature_histogram.hpp:855-1030):
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -57,6 +57,9 @@ class FeatureMeta(NamedTuple):
     missing_type: jnp.ndarray   # [F] int32
     default_bin: jnp.ndarray    # [F] int32
     is_categorical: jnp.ndarray  # [F] bool
+    monotone: Optional[jnp.ndarray] = None  # [F] int8: -1/0/+1 constraint
+    inter_sets: Optional[jnp.ndarray] = None  # [S, F] bool: interaction
+    #                                           constraint set membership
 
 
 class SplitResult(NamedTuple):
@@ -115,12 +118,21 @@ def find_best_split(
     meta: FeatureMeta,
     hp: SplitHyperParams,
     feature_mask: jnp.ndarray | None = None,  # [F] bool (col sampling)
+    leaf_min: jnp.ndarray | None = None,      # scalar: monotone lower bound
+    leaf_max: jnp.ndarray | None = None,      # scalar: monotone upper bound
 ) -> SplitResult:
     """Best numerical split over all features for one leaf.
 
     Returns gain == -inf when no split satisfies the constraints. Categorical
     features are handled by `find_best_split_categorical` (ops/categorical.py)
     and masked out here.
+
+    Monotone constraints follow the reference's "basic" method
+    (BasicConstraint / LeafConstraintsBase::Create,
+    monotone_constraints.hpp:330): child outputs are clamped into the
+    leaf's [leaf_min, leaf_max] bounds inherited from monotone ancestors,
+    and splits on a +-1 monotone feature whose (clamped) child outputs
+    violate the direction are rejected.
     """
     _, F, B = hist.shape
     bins = jnp.arange(B, dtype=jnp.int32)[None, :]          # [1, B]
@@ -176,6 +188,13 @@ def find_best_split(
 
     lout = leaf_output(lg, lh, hp, lc, parent_output)
     rout = leaf_output(rg, rh, hp, rc, parent_output)
+    if leaf_min is not None:
+        lout = jnp.clip(lout, leaf_min, leaf_max)
+        rout = jnp.clip(rout, leaf_min, leaf_max)
+    if meta.monotone is not None:
+        mono = meta.monotone[None, :, None]
+        ok = ok & ~(((mono > 0) & (lout > rout))
+                    | ((mono < 0) & (lout < rout)))
     gain = (leaf_gain_given_output(lg, lh, hp, lout)
             + leaf_gain_given_output(rg, rh, hp, rout))
 
@@ -202,9 +221,11 @@ def find_best_split(
 
     def pick(x):
         # non-selected entries may be inf/NaN (e.g. division by zero-hess
-        # bins); 0.0 * inf = NaN would poison the contraction
+        # bins); 0.0 * inf = NaN would poison the contraction. HIGHEST
+        # precision: the TPU default would round the picked value to bf16.
         xf = x.reshape(-1)
         return jnp.dot(jnp.where(jnp.isfinite(xf), xf, 0.0), onehot,
+                       precision=jax.lax.Precision.HIGHEST,
                        preferred_element_type=jnp.float32)
 
     picked = [pick(x) for x in (lg, lh, lc, rg, rh, rc, lout, rout)]

@@ -19,6 +19,8 @@ tree_learner=data.
 
 from __future__ import annotations
 
+import inspect
+
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -50,11 +52,14 @@ def build_data_parallel_train_fn(mesh: jax.sharding.Mesh,
     (ops/grow.py) or the compacted one (ops/grow_fast.py).
     """
     dist = DistContext(DATA_AXIS)
+    takes_seed = "rng_seed" in inspect.signature(grow_fn).parameters
 
-    def step(X_t, grad, hess, in_bag, scores_k, lr, feat_mask):
-        tree, leaf_of_row = grow_fn(
-            X_t, grad, hess, in_bag, meta, cfg,
-            feature_mask=feat_mask, dist=dist)
+    def step(X_t, grad, hess, in_bag, scores_k, lr, feat_mask, seed):
+        kw = dict(feature_mask=feat_mask, dist=dist)
+        if takes_seed:
+            kw["rng_seed"] = seed
+        tree, leaf_of_row = grow_fn(X_t, grad, hess, in_bag, meta, cfg,
+                                    **kw)
         new_scores = scores_k + (tree.leaf_value * lr)[leaf_of_row]
         return tree, leaf_of_row, new_scores
 
@@ -62,7 +67,7 @@ def build_data_parallel_train_fn(mesh: jax.sharding.Mesh,
     rep = P()
     sharded = jax.shard_map(
         step, mesh=mesh,
-        in_specs=(P(None, DATA_AXIS), row, row, row, row, rep, rep),
+        in_specs=(P(None, DATA_AXIS), row, row, row, row, rep, rep, rep),
         out_specs=(rep, row, row),
         check_vma=False)
     return jax.jit(sharded)

@@ -1,0 +1,97 @@
+"""Monotone + interaction constraints (reference:
+monotone_constraints.hpp:330 basic method; col_sampler.hpp:208), and
+loud failure on unimplemented parsed params."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.log import FatalError
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(5)
+    N = 3000
+    X = rng.uniform(-2, 2, size=(N, 5)).astype(np.float32)
+    y = (2.0 * X[:, 0] - 1.5 * X[:, 1] + np.sin(3 * X[:, 2])
+         + 0.3 * rng.normal(size=N)).astype(np.float32)
+    return X, y
+
+
+def _monotone_violations(b, X, feat, direction, grid=25):
+    """Count monotonicity violations of the model output along `feat`."""
+    rng = np.random.RandomState(0)
+    base = X[rng.choice(len(X), 200, replace=False)].copy()
+    vals = np.linspace(X[:, feat].min(), X[:, feat].max(), grid)
+    prev = None
+    viol = 0
+    for v in vals:
+        Z = base.copy()
+        Z[:, feat] = v
+        p = b.predict(Z)
+        if prev is not None:
+            d = (p - prev) * direction
+            viol += int(np.sum(d < -1e-9))
+        prev = p
+    return viol
+
+
+def test_monotone_constraints_enforced(data):
+    X, y = data
+    params = dict(objective="regression", num_leaves=31, learning_rate=0.2,
+                  verbose=-1, monotone_constraints=[1, -1, 0, 0, 0])
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=20)
+    assert _monotone_violations(b, X, 0, +1) == 0
+    assert _monotone_violations(b, X, 1, -1) == 0
+    # unconstrained training violates (sanity that the test can detect)
+    b0 = lgb.train(dict(objective="regression", num_leaves=31,
+                        learning_rate=0.2, verbose=-1),
+                   lgb.Dataset(X, label=y), num_boost_round=20)
+    assert _monotone_violations(b0, X, 2, +1) > 0
+
+
+def test_monotone_quality_reasonable(data):
+    X, y = data
+    mse0 = float(np.var(y))
+    b = lgb.train(dict(objective="regression", num_leaves=31, verbose=-1,
+                       learning_rate=0.2,
+                       monotone_constraints=[1, -1, 0, 0, 0]),
+                  lgb.Dataset(X, label=y), num_boost_round=25)
+    mse = float(np.mean((y - b.predict(X)) ** 2))
+    assert mse < 0.3 * mse0
+
+
+def test_interaction_constraints(data):
+    X, y = data
+    params = dict(objective="regression", num_leaves=31, learning_rate=0.2,
+                  verbose=-1, interaction_constraints="[0,1],[2]")
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=12)
+    m = b.dump_model()
+
+    def paths(node, cur, out):
+        if "leaf_index" in node:
+            out.append(tuple(sorted(set(cur))))
+        else:
+            f = node["split_feature"]
+            paths(node["left_child"], cur + [f], out)
+            paths(node["right_child"], cur + [f], out)
+        return out
+
+    allowed = [{0, 1}, {2}]
+    for t in m["tree_info"]:
+        for path in paths(t["tree_structure"], [], []):
+            assert any(set(path) <= a for a in allowed), path
+    # features 3,4 are in no constraint set -> never used
+    imp = b.feature_importance()
+    assert imp[3] == 0 and imp[4] == 0
+
+
+def test_unimplemented_params_fail_loudly(data):
+    X, y = data
+    for bad in (dict(linear_tree=True),
+                dict(forcedsplits_filename="f.json"),
+                dict(cegb_penalty_split=0.1)):
+        with pytest.raises(FatalError):
+            lgb.train(dict(objective="regression", verbose=-1, **bad),
+                      lgb.Dataset(X, label=y), num_boost_round=1)
