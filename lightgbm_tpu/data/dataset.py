@@ -108,6 +108,15 @@ class BinnedDataset:
         self.metadata: Optional[Metadata] = None
         self.max_bin: int = 255
         self.reference: Optional["BinnedDataset"] = None
+        # EFB (Exclusive Feature Bundling, dataset.cpp:112 FindGroups /
+        # :251 FastFeatureBundling): sparse features whose non-default
+        # rows never (max_conflict_rate=0) or rarely overlap share one
+        # uint8 column. None = no bundling applied.
+        self.bundles: Optional[List[List[int]]] = None
+        self.X_bundled: Optional[np.ndarray] = None   # [N, F_b] uint8
+        self.bundle_col: Optional[List[int]] = None   # inner f -> column
+        self.bundle_off: Optional[List[int]] = None   # inner f -> offset,
+        #                                               -1 = raw singleton
 
     # -- derived per-feature arrays consumed by device kernels
     @property
@@ -233,4 +242,100 @@ def construct_from_matrix(
     md.set_group(group)
     md.set_init_score(init_score)
     ds.metadata = md
+    if (reference is None and config.enable_bundle
+            and config.boosting in ("gbdt", "gbrt")
+            and config.tpu_grower in ("auto", "wave", "wave_exact")):
+        _build_bundles(ds, config)
     return ds
+
+
+def _build_bundles(ds: BinnedDataset, config: Config) -> None:
+    """Exclusive Feature Bundling (reference: FindGroups dataset.cpp:112,
+    FastFeatureBundling :251): greedily pack features whose non-default
+    rows (almost) never overlap into shared uint8 columns. Histogram and
+    row-scan work then scales with the number of BUNDLES; per-feature
+    histograms are recovered at search time by slicing bundle offsets,
+    with the default bin reconstructed via histogram fix-up
+    (Dataset::FixHistogram, dataset.h:778)."""
+    F = len(ds.mappers)
+    N = ds.num_data
+    if F <= 1 or N == 0 or ds.X_binned.dtype != np.uint8:
+        return
+    X = ds.X_binned
+    # sample rows for conflict counting (the reference counts on its
+    # binning sample)
+    s_cnt = min(N, 50_000)
+    if s_cnt < N:
+        rng = np.random.RandomState(config.data_random_seed)
+        srows = np.sort(rng.choice(N, s_cnt, replace=False))
+        Xs = X[srows]
+    else:
+        Xs = X
+    db = np.array([m.default_bin for m in ds.mappers], np.int64)
+    nb = np.array([m.num_bin for m in ds.mappers], np.int64)
+    is_cat = np.array([m.bin_type == BIN_TYPE_CATEGORICAL
+                       for m in ds.mappers])
+    nondef = Xs != db[None, :]
+    nz = nondef.sum(axis=0)
+    # reference constants (dataset.cpp:118-121)
+    max_search_group = 100
+    max_bin_per_group = 256
+    max_conflict = s_cnt // 10_000
+    order = np.argsort(-nz, kind="stable")
+    groups: List[dict] = []
+    for f in order:
+        f = int(f)
+        if is_cat[f] or nb[f] >= max_bin_per_group:
+            groups.append(dict(members=[f], mask=None, bins=int(nb[f]),
+                               conflicts=0))
+            continue
+        placed = False
+        for g in groups[:max_search_group]:
+            if g["mask"] is None:
+                continue
+            if g["bins"] + int(nb[f]) - 1 > max_bin_per_group:
+                continue
+            conflict = int(np.count_nonzero(nondef[:, f] & g["mask"]))
+            if g["conflicts"] + conflict <= max_conflict:
+                g["members"].append(f)
+                g["mask"] |= nondef[:, f]
+                g["bins"] += int(nb[f]) - 1
+                g["conflicts"] += conflict
+                placed = True
+                break
+        if not placed:
+            groups.append(dict(members=[f], mask=nondef[:, f].copy(),
+                               bins=1 + int(nb[f]) - 1, conflicts=0))
+    n_bundled = sum(1 for g in groups if len(g["members"]) > 1)
+    if n_bundled == 0:
+        return
+    bundle_col = np.zeros(F, np.int32)
+    bundle_off = np.full(F, -1, np.int32)
+    cols = []
+    bundles = []
+    for ci, g in enumerate(groups):
+        members = g["members"]
+        bundles.append(list(members))
+        if len(members) == 1:
+            f = members[0]
+            bundle_col[f] = ci
+            cols.append(X[:, f])
+            continue
+        col = np.zeros(N, np.uint8)
+        off = 1                       # bundle bin 0 = every member default
+        for f in members:
+            b = X[:, f].astype(np.int64)
+            nd = b != db[f]
+            rb = b - (b > db[f])      # compact out the default bin
+            col[nd] = (off + rb[nd]).astype(np.uint8)
+            bundle_col[f] = ci
+            bundle_off[f] = off
+            off += int(nb[f]) - 1
+        cols.append(col)
+    ds.bundles = bundles
+    ds.X_bundled = np.ascontiguousarray(np.stack(cols, axis=1))
+    ds.bundle_col = bundle_col.tolist()
+    ds.bundle_off = bundle_off.tolist()
+    from ..utils.log import log_info
+    log_info(f"EFB: bundled {F} features into {len(groups)} columns "
+             f"({n_bundled} multi-feature bundles)")

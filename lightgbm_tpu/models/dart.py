@@ -35,7 +35,8 @@ class DART(GBDT):
 
     def _binned_host(self):
         if self._Xb_host is None:
-            self._Xb_host = np.asarray(jax.device_get(self.X_t)).T
+            # the ORIGINAL binned matrix (self.X_t may hold EFB bundles)
+            self._Xb_host = self.train_set.X_binned[:self.num_data]
         return self._Xb_host
 
     def _tree_leaves(self, mi: int):

@@ -168,14 +168,42 @@ class GBDT:
             self.N_pad = N_real
 
         max_bin = max((m.num_bin for m in ds.mappers), default=2)
+        # EFB: ship the bundled columns to the device instead of the raw
+        # matrix (the serial growers don't unpack bundles; gated below)
+        self._use_bundles = (ds.bundles is not None
+                             and type(self).__name__ == "GBDT"
+                             and cfg.tpu_grower in ("auto", "wave",
+                                                    "wave_exact"))
+        if self._use_bundles:
+            X = ds.X_bundled
+            max_bin = max(max_bin, int(X.max()) + 1)
+        else:
+            X = ds.X_binned
         self.num_bins_padded = max(_round_up(max_bin, 8), 8)
-        X = ds.X_binned
-        Xt_np = np.ascontiguousarray(X.T)                   # [F, N]
+        Xt_np = np.ascontiguousarray(X.T)                   # [F(b), N]
         if self.N_pad != N_real:
             Xt_np = np.pad(Xt_np, ((0, 0), (0, self.N_pad - N_real)))
         self.X_t = self._put_rows(jnp.asarray(Xt_np), row_axis=1)
         self.meta = build_feature_meta(ds, cfg.monotone_constraints,
                                        cfg.interaction_constraints)
+        if self._use_bundles:
+            F = len(ds.mappers)
+            B = self.num_bins_padded
+            expand = np.full((F, B), len(ds.bundles) * B, np.int32)  # fill
+            mfb = np.zeros((F, B), np.float32)
+            for f, m in enumerate(ds.mappers):
+                ci, off = ds.bundle_col[f], ds.bundle_off[f]
+                dbf, nbf = m.default_bin, m.num_bin
+                mfb[f, dbf] = 1.0
+                for b in range(nbf):
+                    if off < 0:
+                        expand[f, b] = ci * B + b
+                    elif b != dbf:
+                        expand[f, b] = ci * B + off + b - (1 if b > dbf
+                                                           else 0)
+            self.meta = self.meta._replace(
+                bundle_expand=jnp.asarray(expand.reshape(-1)),
+                bundle_mfb=jnp.asarray(mfb))
         if self.meta.monotone is not None \
                 and cfg.monotone_constraints_method not in ("basic",):
             log_warning("monotone_constraints_method="
@@ -207,6 +235,12 @@ class GBDT:
             num_grad_quant_bins=cfg.num_grad_quant_bins,
             stochastic_rounding=cfg.stochastic_rounding,
             quant_renew_leaf=cfg.quant_train_renew_leaf,
+            bundle_col=(tuple(ds.bundle_col) if self._use_bundles else ()),
+            bundle_off=(tuple(ds.bundle_off) if self._use_bundles else ()),
+            bundle_nb=(tuple(int(m.num_bin) for m in ds.mappers)
+                       if self._use_bundles else ()),
+            bundle_db=(tuple(int(m.default_bin) for m in ds.mappers)
+                       if self._use_bundles else ()),
         )
 
         # grower selection: "wave" (default via auto) applies batched
@@ -233,6 +267,9 @@ class GBDT:
             self.grower = "compact"
         else:
             self.grower = "masked"
+        if self._use_bundles and self.grower not in ("wave",
+                                                     "wave_exact"):
+            self.grower = "wave"   # only the wave grower unpacks bundles
         if cfg.use_quantized_grad and self.grower not in ("wave",
                                                           "wave_exact"):
             log_warning("use_quantized_grad is implemented by the wave "
@@ -673,7 +710,8 @@ class GBDT:
         if not trees:
             return
         K = self.num_tree_per_iteration
-        Xb = np.asarray(jax.device_get(self.X_t)).T[:self.num_data]
+        # the ORIGINAL binned matrix: self.X_t may hold EFB bundle columns
+        Xb = self.train_set.X_binned[:self.num_data]
         add = np.zeros((K, self.num_data), np.float32)
         for i, tree in enumerate(trees):
             self._ensure_binned_traversal(tree)
@@ -815,7 +853,7 @@ class GBDT:
             kk = K - 1 - k
             # subtract this tree's contribution from the scores
             leaf = tree.get_leaf_binned(
-                np.asarray(jax.device_get(self.X_t)).T, self)
+                self.train_set.X_binned[:self.num_data], self)
             self.scores = self.scores.at[kk].add(
                 -jnp.asarray(tree.leaf_value[leaf], dtype=jnp.float32))
             for vi, ds in enumerate(self.valid_sets):

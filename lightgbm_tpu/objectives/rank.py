@@ -1,17 +1,27 @@
 """Ranking objectives: LambdaRank (NDCG-weighted pairwise) and RankXENDCG.
 
-Faithful ports of src/objective/rank_objective.hpp:26-370. Gradients are
-computed per query; here each query's pairwise accumulation is vectorized
-with numpy outer products over the (truncation_level x cnt) pair block
-instead of the reference's double loop. These run on host per iteration
-(`runs_on_host = True`); a padded-batch device path is planned (queries padded
-to equal length, vmapped — the ranking analog of sequence bucketing).
+Faithful ports of src/objective/rank_objective.hpp:26-370 (the reference
+parallelizes per query with OpenMP; the CUDA backend has per-query device
+kernels, cuda/cuda_rank_objective.cu).
+
+LambdaRank runs ON DEVICE: queries are bucketed by padded length (the
+ranking analog of sequence bucketing), each bucket's scores are gathered
+into a dense [num_queries, padded_len] block with FIXED index matrices,
+and the per-query sort + truncated pair-block lambda accumulation is pure
+vectorized jnp — both pair-sides reduce along the pair axes, so no
+scatter is needed. This removes the per-iteration host score pull the
+host path needs (gbdt boost()).
+
+RankXENDCG stays host-side: it draws fresh uniforms every iteration
+(rank_objective.hpp:330), which doesn't fit the stateless device
+objective interface yet.
 """
 
 from __future__ import annotations
 
 from typing import Optional, Tuple
 
+import jax.numpy as jnp
 import numpy as np
 
 from ..config import Config
@@ -89,6 +99,132 @@ class LambdarankNDCG(RankingObjective):
             max_dcg = float(np.sum(self.label_gain[top]
                                    / np.log2(np.arange(2, len(top) + 2))))
             self.inverse_max_dcgs[q] = 1.0 / max_dcg if max_dcg > 0 else 0.0
+        self._build_device_buckets()
+
+    # -- device path -------------------------------------------------
+    runs_on_host = False
+
+    def _build_device_buckets(self) -> None:
+        """Bucket queries by padded (power-of-2) length; per bucket keep
+        FIXED device matrices: row indices into the flat score vector,
+        label gains / ids, query lengths, inverse max DCGs. Also the
+        fixed inverse map flattening bucket space back to rows."""
+        qb = np.asarray(self.query_boundaries, np.int64)
+        lengths = np.diff(qb)
+        N = self.num_data
+        buckets = {}
+        for q, ln in enumerate(lengths):
+            plen = 1 << max(3, int(np.ceil(np.log2(max(ln, 1)))))
+            buckets.setdefault(plen, []).append(q)
+        self._buckets = []
+        pos_of_row = np.zeros(N, np.int64)
+        offset = 0
+        gain_table = self.label_gain
+        for plen in sorted(buckets):
+            qs = buckets[plen]
+            nq = len(qs)
+            idx = np.full((nq, plen), N, np.int64)   # N = zero sentinel
+            lab = np.full((nq, plen), -1, np.int32)
+            cnt = np.zeros(nq, np.int32)
+            imd = np.zeros(nq, np.float32)
+            for i, q in enumerate(qs):
+                s, e = int(qb[q]), int(qb[q + 1])
+                ln = e - s
+                idx[i, :ln] = np.arange(s, e)
+                lab[i, :ln] = self.label[s:e].astype(np.int32)
+                cnt[i] = ln
+                imd[i] = self.inverse_max_dcgs[q]
+                pos_of_row[s:e] = offset + i * plen + np.arange(ln)
+            self._buckets.append(dict(
+                plen=plen,
+                idx=jnp.asarray(idx),
+                gain=jnp.asarray(
+                    np.where(lab >= 0, gain_table[np.maximum(lab, 0)], 0.0)
+                    .astype(np.float32)),
+                lab=jnp.asarray(lab),
+                cnt=jnp.asarray(cnt),
+                imd=jnp.asarray(imd),
+            ))
+            offset += nq * plen
+        self._pos_of_row = jnp.asarray(pos_of_row)
+
+    def get_gradients(self, score, label, weight):
+        """Device lambdarank (GetGradientsForOneQuery,
+        rank_objective.hpp:188-260, vectorized over bucketed queries)."""
+        n_pad = score.shape[0]
+        s_ext = jnp.concatenate([score.astype(jnp.float32),
+                                 jnp.zeros((1,), jnp.float32)])
+        sig = jnp.float32(self.sigmoid)
+        outs_g, outs_h = [], []
+        for bk in self._buckets:
+            plen = bk["plen"]
+            s = s_ext[bk["idx"]]                            # [nq, plen]
+            cnt = bk["cnt"][:, None]
+            posn = jnp.arange(plen, dtype=jnp.int32)[None, :]
+            valid_pos = posn < cnt
+            key = jnp.where(valid_pos, -s, jnp.inf)
+            order = jnp.argsort(key, axis=1)                # [nq, plen]
+            ss = jnp.take_along_axis(s, order, axis=1)
+            gn = jnp.take_along_axis(bk["gain"], order, axis=1)
+            lb = jnp.take_along_axis(bk["lab"], order, axis=1)
+            Ti = min(plen - 1, self.truncation_level)
+            Ii = jnp.arange(Ti, dtype=jnp.int32)
+            Jj = jnp.arange(plen, dtype=jnp.int32)
+            pair_ok = ((Jj[None, None, :] > Ii[None, :, None])
+                       & (Jj[None, None, :] < cnt[:, :1, None])
+                       & (lb[:, :Ti, None] != lb[:, None, :])
+                       & (lb[:, :Ti, None] >= 0) & (lb[:, None, :] >= 0))
+            disc = (1.0 / jnp.log2(2.0 + Jj.astype(jnp.float32)))
+            dcg_gap = jnp.abs(gn[:, :Ti, None] - gn[:, None, :])
+            pdisc = jnp.abs(disc[None, :Ti, None] - disc[None, None, :])
+            delta_ndcg = dcg_gap * pdisc * bk["imd"][:, None, None]
+            hi_is_i = lb[:, :Ti, None] > lb[:, None, :]
+            dscore = jnp.where(hi_is_i,
+                               ss[:, :Ti, None] - ss[:, None, :],
+                               ss[:, None, :] - ss[:, :Ti, None])
+            if self.norm:
+                best = ss[:, :1]
+                worst = jnp.take_along_axis(
+                    ss, jnp.maximum(cnt - 1, 0), axis=1)
+                do_norm = (best != worst)[:, :, None]
+                delta_ndcg = jnp.where(
+                    do_norm, delta_ndcg / (0.01 + jnp.abs(dscore)),
+                    delta_ndcg)
+            p0 = 1.0 / (1.0 + jnp.exp(sig * dscore))
+            m = pair_ok.astype(jnp.float32)
+            p_l = -sig * delta_ndcg * p0 * m
+            p_h = sig * sig * delta_ndcg * p0 * (1.0 - p0) * m
+            # both pair sides reduce along an axis — no scatter
+            li = jnp.sum(jnp.where(hi_is_i, p_l, -p_l), axis=2)  # [nq, Ti]
+            ljc = jnp.sum(jnp.where(hi_is_i, -p_l, p_l), axis=1)  # [nq,plen]
+            hic = jnp.sum(p_h, axis=2)
+            hjc = jnp.sum(p_h, axis=1)
+            lam_sorted = ljc.at[:, :Ti].add(li)
+            hes_sorted = hjc.at[:, :Ti].add(hic)
+            if self.norm:
+                sum_l = -2.0 * jnp.sum(p_l, axis=(1, 2))
+                nf = jnp.where(sum_l > 0,
+                               jnp.log2(1.0 + sum_l)
+                               / jnp.maximum(sum_l, _KEPS), 1.0)
+                lam_sorted *= nf[:, None]
+                hes_sorted *= nf[:, None]
+            inv_order = jnp.argsort(order, axis=1)
+            outs_g.append(jnp.take_along_axis(lam_sorted, inv_order,
+                                              axis=1).reshape(-1))
+            outs_h.append(jnp.take_along_axis(hes_sorted, inv_order,
+                                              axis=1).reshape(-1))
+        gflat = jnp.concatenate(outs_g)
+        hflat = jnp.concatenate(outs_h)
+        g = gflat[self._pos_of_row]
+        h = hflat[self._pos_of_row]
+        if weight is not None:
+            w = weight[:g.shape[0]]
+            g, h = g * w, h * w
+        if n_pad > g.shape[0]:
+            pad = n_pad - g.shape[0]
+            g = jnp.pad(g, (0, pad))
+            h = jnp.pad(h, (0, pad))
+        return g, h
 
     def _one_query(self, qid, label, score):
         cnt = len(label)

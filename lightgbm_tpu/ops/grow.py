@@ -80,6 +80,18 @@ class GrowConfig(NamedTuple):
     num_grad_quant_bins: int = 4
     stochastic_rounding: bool = True
     quant_renew_leaf: bool = False
+    # EFB (data/dataset.py:_build_bundles): X_t holds BUNDLE columns;
+    # static per-ORIGINAL-feature maps unpack them in the row pass, and
+    # meta.bundle_expand re-slices bundle histograms per feature at
+    # search time. Empty tuples = no bundling.
+    bundle_col: tuple = ()      # orig feature -> bundle column
+    bundle_off: tuple = ()      # offset in the bundle, -1 = raw singleton
+    bundle_nb: tuple = ()       # orig feature num_bin
+    bundle_db: tuple = ()       # orig feature default bin
+
+    @property
+    def bundled(self) -> bool:
+        return len(self.bundle_col) > 0
 
     @property
     def hp(self) -> SplitHyperParams:

@@ -172,7 +172,10 @@ def grow_tree_wave(
     rng_seed: Optional[jnp.ndarray] = None,
 ) -> tuple[DeviceTree, jnp.ndarray]:
     """Wave-pipelined exact leaf-wise growth; contract of grow.py:grow_tree."""
-    F, N = X_t.shape
+    # with EFB, X_t holds BUNDLE columns; F is the ORIGINAL feature count
+    # (search/meta space), X_t.shape[0] the storage columns
+    N = X_t.shape[1]
+    F = int(meta.num_bins.shape[0])
     L = cfg.num_leaves
     M = max(L - 1, 1)
     B = cfg.num_bins_padded
@@ -244,7 +247,20 @@ def grow_tree_wave(
         return m if feature_mask is None else m & feature_mask
 
     def search(hist2, sum_g, sum_h, count, out, bmin, bmax, sets_row):
-        hist2 = to_f32(hist2)
+        if cfg.bundled:
+            # EFB: re-slice the bundle histogram per ORIGINAL feature
+            # (Dataset::ConstructHistograms offsets) and reconstruct each
+            # feature's default bin as parent - sum(others)
+            # (Dataset::FixHistogram, dataset.h:778)
+            flat = hist2.reshape(2, -1)
+            hist2 = jnp.take(flat, meta.bundle_expand, axis=1,
+                             mode="fill", fill_value=0).reshape(2, F, B)
+            hist2 = to_f32(hist2)
+            parent2 = jnp.stack([sum_g, sum_h])
+            miss = parent2[:, None] - jnp.sum(hist2, axis=-1)   # [2, F]
+            hist2 = hist2 + meta.bundle_mfb[None] * miss[:, :, None]
+        else:
+            hist2 = to_f32(hist2)
         cntf = count / jnp.maximum(sum_h, 1e-12)
         hist = jnp.concatenate([hist2, hist2[1:2] * cntf], axis=0)
         fmask = sets_to_fmask(sets_row) if has_inter else feature_mask
@@ -333,9 +349,9 @@ def grow_tree_wave(
         leaf_output=jnp.zeros((L,), jnp.float32).at[0].set(root_out),
         leaf_sum_g=jnp.zeros((L,), jnp.float32).at[0].set(root_g),
         leaf_sum_h=jnp.zeros((L,), jnp.float32).at[0].set(root_h),
-        hist_cache=jnp.zeros((L, 2, F, B),
+        hist_cache=jnp.zeros((L,) + hist_root.shape,
                              hist_root.dtype).at[0].set(hist_root),
-        small_hist=jnp.zeros((L, 2, F, B), hist_root.dtype),
+        small_hist=jnp.zeros((L,) + hist_root.shape, hist_root.dtype),
         small_is_left=jnp.zeros((L,), bool),
         ready=jnp.zeros((L,), bool),
         leaf_min=jnp.full((L,), -jnp.inf, jnp.float32),
@@ -379,8 +395,22 @@ def grow_tree_wave(
         db = jnp.zeros((N,), jnp.int32)
         nb = jnp.zeros((N,), jnp.int32)
         for f in range(F):
+            if cfg.bundled:
+                src = X_t[cfg.bundle_col[f]].astype(jnp.int32)
+                off = cfg.bundle_off[f]
+                if off < 0:
+                    binv = src               # raw singleton column
+                else:
+                    # unpack the bundle slot back to the feature's bins
+                    # (FastFeatureBundling inverse, dataset.cpp:251)
+                    nbf, dbf = cfg.bundle_nb[f], cfg.bundle_db[f]
+                    rb = src - off
+                    inr = (rb >= 0) & (rb < nbf - 1)
+                    binv = jnp.where(inr, rb + (rb >= dbf), dbf)
+            else:
+                binv = X_t[f].astype(jnp.int32)
             fm = feat == f
-            col = jnp.where(fm, X_t[f].astype(jnp.int32), col)
+            col = jnp.where(fm, binv, col)
             mt = jnp.where(fm, meta.missing_type[f], mt)
             db = jnp.where(fm, meta.default_bin[f], db)
             nb = jnp.where(fm, meta.num_bins[f], nb)
@@ -440,6 +470,22 @@ def grow_tree_wave(
                 & (s.n_applied < KMAX)
 
         return sim_cond, sim_step
+
+    def table_go_left_bucketed(n_active, leaf_of_row, tbl, f, t, d, ic, bt):
+        """table_go_left with the select-chain length bucketed to the
+        actual wave size (active entries are a prefix): small waves must
+        not pay the KMAX-length compare chain."""
+        def mk(Kb):
+            def br(args):
+                lor, tbl_, f_, t_, d_, ic_, bt_ = args
+                return table_go_left(lor, tbl_[:Kb], f_[:Kb], t_[:Kb],
+                                     d_[:Kb], ic_[:Kb], bt_[:Kb])
+            return br
+        kidx = jnp.minimum(
+            jnp.searchsorted(bucket_bounds, n_active).astype(jnp.int32),
+            len(buckets) - 1)
+        return jax.lax.switch(kidx, [mk(Kb) for Kb in buckets],
+                              (leaf_of_row, tbl, f, t, d, ic, bt))
 
     def wave_step(st: _WaveState) -> _WaveState:
         j_iota = jnp.arange(KMAX, dtype=jnp.int32)
@@ -595,8 +641,8 @@ def grow_tree_wave(
         # ---- one fused row pass: RELABEL applied splits, then evaluate
         # candidate membership on the NEW leaf (both are elementwise
         # select-chain passes sharing the X reads)
-        slot_app, in_app, gl_app = table_go_left(
-            st.leaf_of_row, app_leaf, bs2.feature, bs2.threshold,
+        slot_app, in_app, gl_app = table_go_left_bucketed(
+            napp, st.leaf_of_row, app_leaf, bs2.feature, bs2.threshold,
             bs2.default_left, iscat2, bits2)
         # right child of applied split j is leaf nl0 + j
         leaf_of_row = jnp.where(in_app & ~gl_app,
@@ -604,8 +650,8 @@ def grow_tree_wave(
         st = st._replace(leaf_of_row=leaf_of_row)
 
         cand_tbl = jnp.where(valid, cand, -1)
-        slot_row, in_cand, gl_cand = table_go_left(
-            leaf_of_row, cand_tbl, bs.feature, bs.threshold,
+        slot_row, in_cand, gl_cand = table_go_left_bucketed(
+            n_cand, leaf_of_row, cand_tbl, bs.feature, bs.threshold,
             bs.default_left, st.best_is_cat[cand], st.best_bitset[cand])
 
         # smaller child of each candidate (global counts from the split

@@ -36,7 +36,10 @@ import jax.numpy as jnp
 
 from ..models.tree import MISSING_NAN, MISSING_NONE, MISSING_ZERO
 
-NEG_INF = jnp.float32(-jnp.inf)
+# plain python float: a module-level jnp computation would initialize the
+# XLA backend at import time, breaking multi-host bring-up
+# (jax.distributed.initialize must run before any backend touch)
+NEG_INF = float("-inf")
 
 
 class SplitHyperParams(NamedTuple):
@@ -60,6 +63,10 @@ class FeatureMeta(NamedTuple):
     monotone: Optional[jnp.ndarray] = None  # [F] int8: -1/0/+1 constraint
     inter_sets: Optional[jnp.ndarray] = None  # [S, F] bool: interaction
     #                                           constraint set membership
+    bundle_expand: Optional[jnp.ndarray] = None  # [F*B] i32: EFB bundle-
+    #   histogram -> per-feature histogram gather map (OOB = fill 0)
+    bundle_mfb: Optional[jnp.ndarray] = None     # [F, B] f32 one-hot of
+    #   each feature's default bin (FixHistogram reconstruction)
 
 
 class SplitResult(NamedTuple):

@@ -53,7 +53,7 @@ class Timer:
             (jax.effects_barrier if hasattr(jax, "effects_barrier")
              else lambda: None)()
             for d in jax.live_arrays():
-                pass
+                d.block_until_ready()
         except Exception:
             pass
 
