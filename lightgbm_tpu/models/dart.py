@@ -53,11 +53,15 @@ class DART(GBDT):
     # -- helpers ------------------------------------------------------
     def _tree_score_binned(self, tree, Xb_t_host=None):
         """[K-slice] training-score contribution of `tree` at its CURRENT
-        leaf values (host computation over the binned matrix)."""
+        leaf values (host computation over the binned matrix), padded to
+        the device score row length."""
         if Xb_t_host is None:
             Xb_t_host = self._binned_host()
         leaf = tree.get_leaf_binned(Xb_t_host, self)
-        return tree.leaf_value[leaf].astype(np.float32)
+        contrib = tree.leaf_value[leaf].astype(np.float32)
+        if self.N_pad != self.num_data:
+            contrib = np.pad(contrib, (0, self.N_pad - self.num_data))
+        return contrib
 
     def _select_dropping_trees(self) -> None:
         """dart.hpp DroppingTrees:99-149."""

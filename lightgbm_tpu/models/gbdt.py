@@ -241,6 +241,7 @@ class GBDT:
                        if self._use_bundles else ()),
             bundle_db=(tuple(int(m.default_bin) for m in ds.mappers)
                        if self._use_bundles else ()),
+            n_shards=(self.n_shards if self.use_dist else 1),
         )
 
         # grower selection: "wave" (default via auto) applies batched
@@ -269,7 +270,17 @@ class GBDT:
             self.grower = "masked"
         if self._use_bundles and self.grower not in ("wave",
                                                      "wave_exact"):
-            self.grower = "wave"   # only the wave grower unpacks bundles
+            # the memory guard picked a serial grower; bundles only work
+            # on the wave path, so re-check the wave budget with the
+            # BUNDLED column count before deciding
+            fb = len(ds.bundles)
+            wave_bytes_b = 2 * (cfg.num_leaves
+                                + _wave_buckets(cfg.num_leaves)[-1]) \
+                * fb * self.num_bins_padded * 2 * 4
+            if wave_bytes_b <= pool_limit:
+                self.grower = "wave"
+            else:
+                self._use_bundles = False   # ship the raw matrix instead
         if cfg.use_quantized_grad and self.grower not in ("wave",
                                                           "wave_exact"):
             log_warning("use_quantized_grad is implemented by the wave "
@@ -854,8 +865,11 @@ class GBDT:
             # subtract this tree's contribution from the scores
             leaf = tree.get_leaf_binned(
                 self.train_set.X_binned[:self.num_data], self)
+            contrib = np.asarray(tree.leaf_value[leaf], np.float32)
+            if self.N_pad != self.num_data:
+                contrib = np.pad(contrib, (0, self.N_pad - self.num_data))
             self.scores = self.scores.at[kk].add(
-                -jnp.asarray(tree.leaf_value[leaf], dtype=jnp.float32))
+                -self._put_rows(jnp.asarray(contrib)))
             for vi, ds in enumerate(self.valid_sets):
                 leaf_v = tree.get_leaf_binned(ds.X_binned, self)
                 self._valid_scores[vi] = self._valid_scores[vi].at[kk].add(

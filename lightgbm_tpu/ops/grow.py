@@ -89,6 +89,10 @@ class GrowConfig(NamedTuple):
     bundle_nb: tuple = ()       # orig feature num_bin
     bundle_db: tuple = ()       # orig feature default bin
 
+    # data-parallel mesh size; >1 enables reduce-scatter feature ownership
+    # in the wave grower (data_parallel_tree_learner.cpp:72-122)
+    n_shards: int = 1
+
     @property
     def bundled(self) -> bool:
         return len(self.bundle_col) > 0

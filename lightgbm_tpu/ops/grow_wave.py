@@ -239,14 +239,54 @@ def grow_tree_wave(
     has_inter = meta.inter_sets is not None
     S = meta.inter_sets.shape[0] if has_inter else 1
 
-    def sets_to_fmask(sets_row):
-        """[S] bool active-constraint sets -> [F] bool allowed features,
-        combined with the global column-sampling mask (ColSampler with
-        interaction constraints, col_sampler.hpp:208)."""
-        m = jnp.any(meta.inter_sets & sets_row[:, None], axis=0)
-        return m if feature_mask is None else m & feature_mask
+    # ---- reduce-scatter feature ownership (tree_learner=data comm
+    # scaling, data_parallel_tree_learner.cpp:72-122 PrepareBufferPos +
+    # :286 ReduceScatter): each shard owns a feature slice of the summed
+    # wave histograms, searches only its features, and the per-leaf best
+    # splits are merged by an allgather of the tiny split records
+    # (SyncUpGlobalBestSplit, parallel_tree_learner.h:210). Histogram
+    # comm per wave drops from [K,C,F,B] allreduce-everywhere to a
+    # reduce-scatter (1/n received) + O(K) record gather.
+    fo = dist is not None and cfg.n_shards > 1 and not cfg.bundled
+    nsh = cfg.n_shards
+    if fo:
+        from ..utils import round_up
+        Fh_pad = round_up(F, nsh)
+        Fs = Fh_pad // nsh
+        foff = dist.axis_index() * Fs
 
-    def search(hist2, sum_g, sum_h, count, out, bmin, bmax, sets_row):
+        def _slice_f(a, ax, fill=0):
+            if a is None:
+                return None
+            pads = [(0, 0)] * a.ndim
+            pads[ax] = (0, Fh_pad - F)
+            ap = jnp.pad(a, pads, constant_values=fill)
+            return jax.lax.dynamic_slice_in_dim(ap, foff, Fs, ax)
+
+        # padded features get num_bins=0: every bin invalid -> -inf gains
+        meta_sh = meta._replace(
+            num_bins=_slice_f(meta.num_bins, 0),
+            missing_type=_slice_f(meta.missing_type, 0),
+            default_bin=_slice_f(meta.default_bin, 0),
+            is_categorical=_slice_f(meta.is_categorical, 0),
+            monotone=_slice_f(meta.monotone, 0),
+            inter_sets=(_slice_f(meta.inter_sets, 1)
+                        if has_inter else None),
+        )
+        fmask_sh = (_slice_f(feature_mask, 0)
+                    if feature_mask is not None else None)
+    else:
+        meta_sh, fmask_sh = meta, feature_mask
+
+    def sets_to_fmask(sets_row, meta_u, fmask_u):
+        """[S] bool active-constraint sets -> allowed features, combined
+        with the global column-sampling mask (ColSampler with interaction
+        constraints, col_sampler.hpp:208)."""
+        m = jnp.any(meta_u.inter_sets & sets_row[:, None], axis=0)
+        return m if fmask_u is None else m & fmask_u
+
+    def make_search(meta_use, fmask_use):
+      def search(hist2, sum_g, sum_h, count, out, bmin, bmax, sets_row):
         if cfg.bundled:
             # EFB: re-slice the bundle histogram per ORIGINAL feature
             # (Dataset::ConstructHistograms offsets) and reconstruct each
@@ -263,15 +303,16 @@ def grow_tree_wave(
             hist2 = to_f32(hist2)
         cntf = count / jnp.maximum(sum_h, 1e-12)
         hist = jnp.concatenate([hist2, hist2[1:2] * cntf], axis=0)
-        fmask = sets_to_fmask(sets_row) if has_inter else feature_mask
-        num = find_best_split(hist, sum_g, sum_h, count, out, meta, hp,
+        fmask = (sets_to_fmask(sets_row, meta_use, fmask_use)
+                 if has_inter else fmask_use)
+        num = find_best_split(hist, sum_g, sum_h, count, out, meta_use, hp,
                               fmask,
                               leaf_min=bmin if has_mono else None,
                               leaf_max=bmax if has_mono else None)
         if not cfg.has_categorical:
             return num, jnp.zeros((), bool), jnp.zeros((W,), jnp.uint32)
         catres, bitset = find_best_split_categorical(
-            hist, sum_g, sum_h, count, out, meta, hp, cfg.cat, fmask,
+            hist, sum_g, sum_h, count, out, meta_use, hp, cfg.cat, fmask,
             leaf_min=bmin if has_mono else None,
             leaf_max=bmax if has_mono else None)
         use_cat = catres.gain > num.gain
@@ -279,6 +320,10 @@ def grow_tree_wave(
             jnp.where(use_cat, cv, nv) for cv, nv in zip(catres, num)])
         return merged, use_cat, jnp.where(use_cat, bitset,
                                           jnp.zeros((W,), jnp.uint32))
+      return search
+
+    search = make_search(meta, feature_mask)
+    search_sh = make_search(meta_sh, fmask_sh) if fo else search
 
     def child_sets(bs, psets):
         """Constraint sets still satisfiable in the children: the parent's
@@ -316,6 +361,14 @@ def grow_tree_wave(
         jnp.ones((S,), bool))
     root_split = root_split._replace(
         gain=jnp.where(max_depth >= 1, root_split.gain, NEG_INF))
+    if fo:
+        # the per-shard caches hold this shard's feature slice only
+        pads = [(0, 0)] * hist_root.ndim
+        pads[1] = (0, Fh_pad - hist_root.shape[1])
+        hist_cache0 = jax.lax.dynamic_slice_in_dim(
+            jnp.pad(hist_root, pads), foff, Fs, 1)
+    else:
+        hist_cache0 = hist_root
 
     tree = DeviceTree(
         num_leaves=jnp.asarray(1, jnp.int32),
@@ -349,9 +402,9 @@ def grow_tree_wave(
         leaf_output=jnp.zeros((L,), jnp.float32).at[0].set(root_out),
         leaf_sum_g=jnp.zeros((L,), jnp.float32).at[0].set(root_g),
         leaf_sum_h=jnp.zeros((L,), jnp.float32).at[0].set(root_h),
-        hist_cache=jnp.zeros((L,) + hist_root.shape,
-                             hist_root.dtype).at[0].set(hist_root),
-        small_hist=jnp.zeros((L,) + hist_root.shape, hist_root.dtype),
+        hist_cache=jnp.zeros((L,) + hist_cache0.shape,
+                             hist_cache0.dtype).at[0].set(hist_cache0),
+        small_hist=jnp.zeros((L,) + hist_cache0.shape, hist_cache0.dtype),
         small_is_left=jnp.zeros((L,), bool),
         ready=jnp.zeros((L,), bool),
         leaf_min=jnp.full((L,), -jnp.inf, jnp.float32),
@@ -669,8 +722,14 @@ def grow_tree_wave(
         def spec_branch(st):
             kidx = jnp.searchsorted(bucket_bounds, n_cand).astype(jnp.int32)
             kidx = jnp.minimum(kidx, len(buckets) - 1)
-            hist_small = psum(jax.lax.switch(kidx, hist_branches,
-                                             slot_small))
+            hist_local = jax.lax.switch(kidx, hist_branches, slot_small)
+            if fo:
+                pads = [(0, 0)] * hist_local.ndim
+                pads[2] = (0, Fh_pad - hist_local.shape[2])
+                hist_small = dist.psum_scatter(
+                    jnp.pad(hist_local, pads), axis=2)
+            else:
+                hist_small = psum(hist_local)
             hist_parent = _onehot_gather(
                 st.hist_cache, jnp.where(valid, cand, L))    # [K, 2, F, B]
             hist_large = hist_parent - hist_small
@@ -691,9 +750,30 @@ def grow_tree_wave(
             bmax_lr = jnp.concatenate([clmax, crmax])
             csets = child_sets(bs, st.leaf_sets[cand])       # [K, S]
             sets_lr = jnp.concatenate([csets, csets], axis=0)
-            s_lr, cat_lr, bits_lr = jax.vmap(search)(
+            s_lr, cat_lr, bits_lr = jax.vmap(search_sh)(
                 hist_lr, sg_lr, sh_lr, c_lr, o_lr, bmin_lr, bmax_lr,
                 sets_lr)
+            if fo:
+                # map slice-local feature ids to global, then merge the
+                # per-shard bests by gain (SyncUpGlobalBestSplit,
+                # parallel_tree_learner.h:210-233)
+                s_lr = s_lr._replace(feature=s_lr.feature + foff)
+                rec = (tuple(s_lr), cat_lr, bits_lr)
+                allr = jax.tree.map(
+                    lambda a: dist.all_gather(a, axis=0, tiled=False), rec)
+                gains_all = allr[0][0]                    # [n, 2K]
+                pick = jnp.argmax(gains_all, axis=0)      # [2K]
+
+                def take(a):
+                    idx = pick.reshape((1,) + pick.shape
+                                       + (1,) * (a.ndim - 2))
+                    return jnp.take_along_axis(
+                        a, jnp.broadcast_to(idx, (1,) + a.shape[1:]),
+                        axis=0)[0]
+
+                s_lr = SplitResult(*[take(a) for a in allr[0]])
+                cat_lr = take(allr[1])
+                bits_lr = take(allr[2])
             # depth mask applied at store time so the order simulation can
             # use stored gains directly
             can = st.leaf_depth[cand] + 1 < max_depth
