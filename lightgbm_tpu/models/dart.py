@@ -102,7 +102,8 @@ class DART(GBDT):
             for k in range(K):
                 tree = self.models[i * K + k]
                 contrib = self._tree_score_binned(tree, Xb)
-                self.scores = self.scores.at[k].add(-jnp.asarray(contrib))
+                self.scores = self.scores.at[k].add(
+                    -self._put_rows(jnp.asarray(contrib)))
         k_drop = len(self._drop_index)
         if not self.config.xgboost_dart_mode:
             self.shrinkage_rate = self.config.learning_rate / (1.0 + k_drop)
@@ -137,7 +138,7 @@ class DART(GBDT):
                         jnp.asarray(contrib_v * (factor - 1.0)))
                 # train: currently 0 (dropped), target w*factor
                 self.scores = self.scores.at[kk].add(
-                    jnp.asarray(w_contrib * factor))
+                    self._put_rows(jnp.asarray(w_contrib * factor)))
                 tree.shrink(factor)
             if not cfg.uniform_drop:
                 if not cfg.xgboost_dart_mode:

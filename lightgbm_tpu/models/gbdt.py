@@ -270,17 +270,22 @@ class GBDT:
             self.grower = "masked"
         if self._use_bundles and self.grower not in ("wave",
                                                      "wave_exact"):
-            # the memory guard picked a serial grower; bundles only work
-            # on the wave path, so re-check the wave budget with the
-            # BUNDLED column count before deciding
+            # the memory guard picked a serial grower, but X_t/meta/
+            # grow_cfg were already built from the BUNDLED matrix and the
+            # serial growers cannot unpack bundles — the wave grower is
+            # the only valid choice here. Warn if its caches exceed the
+            # configured pool (histogram_pool_size is a soft hint,
+            # serial_tree_learner.cpp:40).
             fb = len(ds.bundles)
             wave_bytes_b = 2 * (cfg.num_leaves
                                 + _wave_buckets(cfg.num_leaves)[-1]) \
                 * fb * self.num_bins_padded * 2 * 4
-            if wave_bytes_b <= pool_limit:
-                self.grower = "wave"
-            else:
-                self._use_bundles = False   # ship the raw matrix instead
+            if wave_bytes_b > pool_limit:
+                log_warning(
+                    "EFB wave histogram caches (%.0f MB) exceed "
+                    "histogram_pool_size; using the wave grower anyway"
+                    % (wave_bytes_b / 1e6))
+            self.grower = "wave"
         if cfg.use_quantized_grad and self.grower not in ("wave",
                                                           "wave_exact"):
             log_warning("use_quantized_grad is implemented by the wave "
@@ -380,8 +385,9 @@ class GBDT:
                     kw["rng_seed"] = seed
                 tree, leaf_of_row = grow_fn(
                     X_t, grad, hess, in_bag, meta, cfg_static, **kw)
-                leaf_shrunk = tree.leaf_value * lr
-                new_scores = scores_k + leaf_shrunk[leaf_of_row]
+                from ..ops.histogram import take_leaf_values
+                new_scores = scores_k + take_leaf_values(
+                    tree.leaf_value * lr, leaf_of_row)
                 return tree, leaf_of_row, new_scores
 
             self._train_tree = train_tree

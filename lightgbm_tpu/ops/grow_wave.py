@@ -65,12 +65,15 @@ from .split import NEG_INF, FeatureMeta, SplitResult, find_best_split
 from .categorical import find_best_split_categorical
 
 
-def _wave_buckets(L: int) -> list[int]:
+def _wave_buckets(L: int, kcap: int = 128) -> list[int]:
     """Static slot-kernel sizes; the smallest bucket >= wave size is used.
-    MXU cost of a slot pass scales with K, so small waves must not pay for
-    the max bucket."""
-    kmax = min(128, max(L - 1, 1))
-    return [k for k in (8, 32) if k < kmax] + [kmax]
+    MXU cost of a slot pass scales linearly with K (measured ~1.1 ms per
+    slot-unit at B=256/N=4M on v5e), so the buckets are exact powers of
+    two: a wave of size K pays for at most 2K slots. `kcap` bounds the
+    widest wave (the megakernel's [K, C, 32, B] VMEM-resident output must
+    stay inside scoped VMEM, ~16 MB on v5e)."""
+    kmax = min(kcap, max(L - 1, 1))
+    return [k for k in (1, 2, 4, 8, 16, 32, 64) if k < kmax] + [kmax]
 
 
 def _oh_dot(oh: jnp.ndarray, flat: jnp.ndarray) -> jnp.ndarray:
@@ -182,9 +185,26 @@ def grow_tree_wave(
     W = cfg.cat_words
     hp = cfg.hp
     max_depth = cfg.max_depth if cfg.max_depth > 0 else 10**9
-    buckets = _wave_buckets(L)
-    KMAX = buckets[-1]
     quant = cfg.use_quantized_grad
+
+    # fused wave megakernel availability (TPU, dense int8 storage, no
+    # categorical, narrow enough to hold all features in one kernel block)
+    from .histogram import _use_pallas
+    use_mega = (_use_pallas(X_t, B) and not cfg.bundled
+                and not cfg.has_categorical and X_t.shape[0] <= 32)
+    if use_mega:
+        # the megakernel's [K, C, 32, B] f32 output block lives in VMEM
+        # for the whole grid; bound K so it stays within scoped VMEM.
+        # The kernel pads the bin axis to the lane-friendly width, so the
+        # budget must use that padded size, not cfg.num_bins_padded.
+        from .histogram_pallas import _compute_dims
+        B_lane = _compute_dims(B)[0]
+        kcap = 4_500_000 // (2 * 32 * B_lane * 4)
+        kcap = max(1 << (kcap.bit_length() - 1), 1) if kcap >= 1 else 1
+        buckets = _wave_buckets(L, min(kcap, 128))
+    else:
+        buckets = _wave_buckets(L)
+    KMAX = buckets[-1]
 
     def psum(x):
         return dist.psum(x) if dist is not None else x
@@ -496,6 +516,37 @@ def grow_tree_wave(
     hist_branches = [make_hist_branch(K) for K in buckets]
     bucket_bounds = jnp.asarray(buckets, jnp.int32)
 
+    # ---- fused wave megakernel (TPU): one pass over the rows performs
+    # split application (relabel), candidate smaller-child membership and
+    # the slot histogram — replacing three separate [N]-sized XLA passes
+    # whose intermediates each round-trip HBM (histogram_pallas.py
+    # _wave_kernel). Falls back to the portable path for CPU meshes,
+    # bundled (EFB) storage, categorical splits, or wide feature counts.
+    if use_mega:
+        from .histogram_pallas import wave_pass_pallas, N_BLK
+        from ..utils import round_up
+        F0 = X_t.shape[0]
+        n_blk = N_BLK if N >= N_BLK else max(round_up(N, 256), 256)
+        Np = round_up(N, n_blk)
+        # pad/convert once per tree; every wave kernel reuses these
+        X_mega = jnp.pad(X_t.astype(jnp.int8),
+                         ((0, 32 - F0), (0, Np - N)))
+        vals_mega = jnp.pad(vals0, ((0, 0), (0, Np - N)))
+
+        def make_mega_branch(K):
+            def branch(args):
+                lor, tbl16 = args
+                new_lor, hist = wave_pass_pallas(X_mega, vals_mega, lor,
+                                                 tbl16, K, B)
+                hist = hist[:, :, :F0, :]
+                if K < KMAX:
+                    hist = jnp.pad(
+                        hist, ((0, KMAX - K), (0, 0), (0, 0), (0, 0)))
+                return new_lor, hist
+            return branch
+
+        mega_branches = [make_mega_branch(K) for K in buckets]
+
     # ---- serial ORDER simulation: each step touches only [L]-sized gain/
     # ready arrays (~10 tiny ops), so the 254-step sequential chain costs
     # milliseconds; the heavy per-split state updates happen vectorized in
@@ -688,41 +739,91 @@ def grow_tree_wave(
         gains, cand = jax.lax.top_k(cand_gain, KMAX)
         cand = cand.astype(jnp.int32)
         valid = (gains > 0.0) & (j_iota < budget2)
+        if not cfg.wave_exact and cfg.wave_gain_slack > 0.0:
+            # mirror the apply guard: a leaf the apply rule would block
+            # anyway is not worth a histogram slot yet — it re-enters once
+            # the frontier's best gain drops to its level. Keeps the slot
+            # count paid per tree near the number of splits actually made
+            # (the apply-side guard is at wave_step's top).
+            nval = jnp.sum(valid).astype(jnp.int32)
+            guard = gains >= cfg.wave_gain_slack * jnp.max(st.best.gain)
+            valid &= guard | (j_iota < (nval + 1) // 2)
         n_cand = jnp.sum(valid).astype(jnp.int32)
         bs = SplitResult(*[x[cand] for x in st.best])
 
-        # ---- one fused row pass: RELABEL applied splits, then evaluate
-        # candidate membership on the NEW leaf (both are elementwise
-        # select-chain passes sharing the X reads)
-        slot_app, in_app, gl_app = table_go_left_bucketed(
-            napp, st.leaf_of_row, app_leaf, bs2.feature, bs2.threshold,
-            bs2.default_left, iscat2, bits2)
-        # right child of applied split j is leaf nl0 + j
-        leaf_of_row = jnp.where(in_app & ~gl_app,
-                                nl0 + slot_app, st.leaf_of_row)
-        st = st._replace(leaf_of_row=leaf_of_row)
-
         cand_tbl = jnp.where(valid, cand, -1)
-        slot_row, in_cand, gl_cand = table_go_left_bucketed(
-            n_cand, leaf_of_row, cand_tbl, bs.feature, bs.threshold,
-            bs.default_left, st.best_is_cat[cand], st.best_bitset[cand])
-
-        # smaller child of each candidate (global counts from the split
-        # record -> identical on all shards); select-chain instead of a
-        # [N]-gather
         smaller_is_left = bs.left_count <= bs.right_count    # [K]
-        sil_row = jnp.zeros((N,), bool)
-        for j in range(KMAX):
-            sil_row = jnp.where(slot_row == j, smaller_is_left[j], sil_row)
-        in_small = in_cand & (gl_cand == sil_row)
-        slot_small = jnp.where(in_small, slot_row, -1)
+
+        if use_mega:
+            # ---- fused megakernel: relabel + candidate membership + slot
+            # histogram in one device pass
+            def gmeta(a, feat):
+                return jnp.take(a, feat, mode="clip").astype(jnp.int32)
+
+            tbl16 = jnp.stack([
+                app_leaf.astype(jnp.int32),
+                bs2.feature.astype(jnp.int32),
+                bs2.threshold.astype(jnp.int32),
+                bs2.default_left.astype(jnp.int32),
+                gmeta(meta.missing_type, bs2.feature),
+                gmeta(meta.default_bin, bs2.feature),
+                gmeta(meta.num_bins, bs2.feature),
+                cand_tbl.astype(jnp.int32),
+                bs.feature.astype(jnp.int32),
+                bs.threshold.astype(jnp.int32),
+                bs.default_left.astype(jnp.int32),
+                gmeta(meta.missing_type, bs.feature),
+                gmeta(meta.default_bin, bs.feature),
+                gmeta(meta.num_bins, bs.feature),
+                smaller_is_left.astype(jnp.int32),
+                jnp.full((KMAX,), nl0, jnp.int32),
+            ])                                               # [16, KMAX]
+            if KMAX < 128:
+                tbl16 = jnp.pad(tbl16, ((0, 0), (0, 128 - KMAX)))
+            kidx_m = jnp.minimum(
+                jnp.searchsorted(
+                    bucket_bounds, jnp.maximum(napp, n_cand)
+                ).astype(jnp.int32), len(buckets) - 1)
+            leaf_of_row, hist_wave = jax.lax.switch(
+                kidx_m, mega_branches, (st.leaf_of_row, tbl16))
+            st = st._replace(leaf_of_row=leaf_of_row)
+            slot_small = None
+        else:
+            # ---- portable path: RELABEL applied splits, then evaluate
+            # candidate membership on the NEW leaf (elementwise
+            # select-chain passes)
+            slot_app, in_app, gl_app = table_go_left_bucketed(
+                napp, st.leaf_of_row, app_leaf, bs2.feature, bs2.threshold,
+                bs2.default_left, iscat2, bits2)
+            # right child of applied split j is leaf nl0 + j
+            leaf_of_row = jnp.where(in_app & ~gl_app,
+                                    nl0 + slot_app, st.leaf_of_row)
+            st = st._replace(leaf_of_row=leaf_of_row)
+
+            slot_row, in_cand, gl_cand = table_go_left_bucketed(
+                n_cand, leaf_of_row, cand_tbl, bs.feature, bs.threshold,
+                bs.default_left, st.best_is_cat[cand], st.best_bitset[cand])
+
+            # smaller child of each candidate (global counts from the split
+            # record -> identical on all shards); select-chain instead of a
+            # [N]-gather
+            sil_row = jnp.zeros((N,), bool)
+            for j in range(KMAX):
+                sil_row = jnp.where(slot_row == j, smaller_is_left[j],
+                                    sil_row)
+            in_small = in_cand & (gl_cand == sil_row)
+            slot_small = jnp.where(in_small, slot_row, -1)
 
         # ---- HIST + SEARCH, skipped entirely when no candidates (e.g.
         # the final wave of a tree)
         def spec_branch(st):
-            kidx = jnp.searchsorted(bucket_bounds, n_cand).astype(jnp.int32)
-            kidx = jnp.minimum(kidx, len(buckets) - 1)
-            hist_local = jax.lax.switch(kidx, hist_branches, slot_small)
+            if use_mega:
+                hist_local = hist_wave
+            else:
+                kidx = jnp.searchsorted(bucket_bounds,
+                                        n_cand).astype(jnp.int32)
+                kidx = jnp.minimum(kidx, len(buckets) - 1)
+                hist_local = jax.lax.switch(kidx, hist_branches, slot_small)
             if fo:
                 pads = [(0, 0)] * hist_local.ndim
                 pads[2] = (0, Fh_pad - hist_local.shape[2])

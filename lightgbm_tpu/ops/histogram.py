@@ -91,6 +91,26 @@ def build_histogram_slots(
                                       num_bins, rows_per_chunk)
 
 
+def take_leaf_values(values: jnp.ndarray,
+                     leaf_of_row: jnp.ndarray) -> jnp.ndarray:
+    """values[leaf_of_row] with the small-table gather replaced by an
+    exact one-hot contraction on TPU (ScoreUpdater::AddScore semantics,
+    score_updater.hpp:22 — the reference walks the partition; XLA's
+    native gather here runs ~50x below HBM speed). Honors the
+    LIGHTGBM_TPU_DISABLE_PALLAS kill switch like every Pallas kernel."""
+    if os.environ.get("LIGHTGBM_TPU_DISABLE_PALLAS", "").lower() \
+            in ("1", "true", "yes"):
+        return values[leaf_of_row]
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except RuntimeError:
+        on_tpu = False
+    if on_tpu and values.ndim == 1 and values.shape[0] <= 2048:
+        from .histogram_pallas import take_leaf_values_pallas
+        return take_leaf_values_pallas(values, leaf_of_row)
+    return values[leaf_of_row]
+
+
 def _build_histogram_xla(X_binned_t, vals, num_bins, rows_per_chunk=8192,
                          dtype=jnp.float32):
     """Portable XLA lowering (also the pinned reference in kernel tests).

@@ -69,6 +69,54 @@ def _compute_dims(num_bins: int):
     return B, LO, HB
 
 
+def _slot_hist_contract(x_ref, out_ref, W, *, K, C, B, LO, HB, acc_dtype,
+                        w_dtype):
+    """Shared slot-histogram contraction: accumulate the [K*C, R]
+    slot-masked values W against per-feature bin one-hots into
+    out_ref[K, C, F_blk, B]. B <= 64 fills only LO of the MXU's 128
+    output lanes, so G = 128/LO features are packed side by side per
+    contraction (full 128-lane output tiles)."""
+    R = x_ref.shape[1]
+    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (LO, R), 0)
+    G = max(128 // LO, 1) if HB == 1 else 1
+
+    for f0 in range(0, x_ref.shape[0], G):
+        if HB == 1:
+            ohs = []
+            for g in range(min(G, x_ref.shape[0] - f0)):
+                # int8 storage sign-extends bins >= 128; mask to unsigned
+                bins_f = x_ref[f0 + g, :].astype(jnp.int32) & 0xFF
+                lo = bins_f & (LO - 1)
+                ohs.append((lo[None, :] == lo_iota).astype(w_dtype))
+            oh = ohs[0] if len(ohs) == 1 else jnp.concatenate(ohs, axis=0)
+            part = jax.lax.dot_general(
+                W, oh, (((1,), (1,)), ((), ())),
+                preferred_element_type=acc_dtype)      # [K*C, G*LO]
+            for g in range(len(ohs)):
+                out_ref[:, :, f0 + g, :] += \
+                    part[:, g * LO:(g + 1) * LO].reshape(K, C, B)
+        else:
+            bins_f = x_ref[f0, :].astype(jnp.int32) & 0xFF
+            lo = bins_f & (LO - 1)
+            oh_lo = (lo[None, :] == lo_iota).astype(w_dtype)
+            hi = bins_f >> 7
+            for hb in range(HB):
+                Whb = jnp.where((hi == hb)[None, :], W, 0)
+                part = jax.lax.dot_general(
+                    Whb, oh_lo, (((1,), (1,)), ((), ())),
+                    preferred_element_type=acc_dtype)
+                out_ref[:, :, f0, hb * LO:(hb + 1) * LO] += \
+                    part.reshape(K, C, LO)
+
+
+def _slot_mask_W(vals, sl, K, w_dtype):
+    """[K*C, R] slot-masked value channels (shared across all features)."""
+    w_rows = []
+    for k in range(K):
+        w_rows.append(jnp.where((sl == k)[None, :], vals, 0))
+    return jnp.concatenate(w_rows, axis=0).astype(w_dtype)
+
+
 def _slots_kernel(x_ref, v_ref, s_ref, out_ref, *, K, C, B, LO, HB,
                   quantized):
     """Grid (F_blocks, N_blocks); N varies fastest so out_ref stays resident.
@@ -88,41 +136,12 @@ def _slots_kernel(x_ref, v_ref, s_ref, out_ref, *, K, C, B, LO, HB,
     def _():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    R = v_ref.shape[1]
     sl = s_ref[0, :]                                       # [R] i32
-    vals = v_ref[...]                                      # [C, R]
     w_dtype = jnp.int8 if quantized else jnp.bfloat16
     acc_dtype = jnp.int32 if quantized else jnp.float32
-
-    # W [K*C, R]: slot-masked value channels — shared across all features
-    w_rows = []
-    for k in range(K):
-        mk = sl == k
-        w_rows.append(jnp.where(mk[None, :], vals, 0))
-    W = jnp.concatenate(w_rows, axis=0).astype(w_dtype)    # [K*C, R]
-
-    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (LO, R), 0)
-
-    for f in range(x_ref.shape[0]):
-        # int8 storage sign-extends bins >= 128; mask back to unsigned
-        bins_f = x_ref[f, :].astype(jnp.int32) & 0xFF      # [R]
-        lo = bins_f & (LO - 1)
-        oh_lo = (lo[None, :] == lo_iota).astype(w_dtype)   # [LO, R]
-        if HB == 1:
-            # one MXU contraction per feature: [K*C, R] x [LO, R]^T
-            part = jax.lax.dot_general(
-                W, oh_lo, (((1,), (1,)), ((), ())),
-                preferred_element_type=acc_dtype)          # [K*C, LO]
-            out_ref[:, :, f, :] += part.reshape(K, C, B)
-        else:
-            hi = bins_f >> 7
-            for hb in range(HB):
-                Whb = jnp.where((hi == hb)[None, :], W, 0)
-                part = jax.lax.dot_general(
-                    Whb, oh_lo, (((1,), (1,)), ((), ())),
-                    preferred_element_type=acc_dtype)
-                out_ref[:, :, f, hb * LO:(hb + 1) * LO] += \
-                    part.reshape(K, C, LO)
+    W = _slot_mask_W(v_ref[...], sl, K, w_dtype)           # [K*C, R]
+    _slot_hist_contract(x_ref, out_ref, W, K=K, C=C, B=B, LO=LO, HB=HB,
+                        acc_dtype=acc_dtype, w_dtype=w_dtype)
 
 
 @functools.partial(jax.jit,
@@ -188,6 +207,228 @@ def build_histogram_slots_pallas(
     )(X, v, s[None, :])
 
     return out[:, :, :F, :num_bins]
+
+
+def _leaf_values_kernel(lor_ref, val_ref, out_ref, *, Lp):
+    """out[r] = val[lor[r]] as an exact one-hot contraction (XLA's native
+    [N]-gather from a tiny table runs at ~0.6 GB/s on this target; the
+    one-hot matmul streams at HBM speed). Out-of-range lor rows yield 0."""
+    lor = lor_ref[0, :]                                    # [R] i32
+    iota = jax.lax.broadcasted_iota(jnp.int32, (Lp, lor.shape[0]), 0)
+    oh = (lor[None, :] == iota).astype(jnp.float32)        # [Lp, R]
+    # HIGHEST: exactly one 1.0 x value product per row -> exact f32
+    out_ref[...] = jax.lax.dot_general(
+        val_ref[...], oh, (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32)                # [1, R]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def take_leaf_values_pallas(
+    values: jnp.ndarray,       # [L] f32 per-leaf values
+    leaf_of_row: jnp.ndarray,  # [N] int32
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Exact values[leaf_of_row] -> [N] f32 on TPU."""
+    L, = values.shape
+    N, = leaf_of_row.shape
+    Lp = _round_up(L, 8)
+    n_blk = 4096 if N >= 4096 else max(_round_up(N, 256), 256)
+    # bound the [Lp, n_blk] f32 one-hot to ~4 MB of VMEM
+    while Lp * n_blk * 4 > 4_194_304 and n_blk > 256:
+        n_blk //= 2
+    Np = _round_up(N, n_blk)
+    v = values.astype(jnp.float32)
+    if Lp != L:
+        v = jnp.pad(v, (0, Lp - L))
+    lor = leaf_of_row.astype(jnp.int32)
+    if Np != N:
+        lor = jnp.pad(lor, (0, Np - N), constant_values=-1)
+    out = pl.pallas_call(
+        functools.partial(_leaf_values_kernel, Lp=Lp),
+        grid=(Np // n_blk,),
+        in_specs=[
+            pl.BlockSpec((1, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Lp), lambda n: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, n_blk), lambda n: (0, n),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, Np), jnp.float32),
+        interpret=interpret,
+    )(lor[None, :], v[None, :])
+    return out[0, :N]
+
+
+# ---------------------------------------------------------------------------
+# Wave megakernel: one fused pass per wave doing split APPLICATION (row
+# relabel), candidate smaller-child membership, and the slot histogram.
+# The unfused path materializes several [N]-sized intermediates between
+# XLA ops (leaf relabel pass, candidate pass, slot ids) that each run at
+# a few GB/s; fusing them into the histogram's row sweep makes the whole
+# wave cost one X read plus the MXU contractions. Reference semantics:
+# DataPartition::Split (data_partition.hpp:102) for the relabel and
+# Dataset::ConstructHistograms (dataset.h:745) for the histogram — one
+# kernel instead of the reference's three hot loops.
+# ---------------------------------------------------------------------------
+
+# rows of the packed [T_ROWS, 128] i32 wave table
+_T_APP_LEAF, _T_APP_FEAT, _T_APP_THR, _T_APP_DL, _T_APP_MT, _T_APP_DB, \
+    _T_APP_NB, _T_CAND_LEAF, _T_CAND_FEAT, _T_CAND_THR, _T_CAND_DL, \
+    _T_CAND_MT, _T_CAND_DB, _T_CAND_NB, _T_CAND_SIL, _T_NL0 = range(16)
+T_ROWS = 16
+_MT_ZERO = 1      # must match models/tree.py MISSING_ZERO
+_MT_NAN = 2       # must match models/tree.py MISSING_NAN
+
+
+def _wave_kernel(x_ref, v_ref, lor_ref, tbl_ref, newlor_ref, out_ref, *,
+                 K, C, B, LO, F, quantized):
+    """Grid (N_blocks,). x_ref [F_pad, R]; v_ref [C, R]; lor_ref [1, R];
+    tbl_ref [T_ROWS, 128] i32; newlor_ref [1, R]; out_ref [K, C, F_pad, B]
+    (VMEM-resident across the whole grid)."""
+    n = pl.program_id(0)
+
+    @pl.when(n == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    R = v_ref.shape[1]
+    lor = lor_ref[0, :]                                    # [R] i32
+    tbl = tbl_ref[...]                                     # [16, 128] i32
+    neg1 = jnp.full((R,), -1, jnp.int32)
+    zero = jnp.zeros((R,), jnp.int32)
+
+    def chain(key, rows, k_hi):
+        """Map each row's `key` through the slot table: returns slot plus
+        one selected value per requested table row (compare-select chains;
+        [R]-wide, no gathers)."""
+        slot = neg1
+        outs = [zero] * len(rows)
+        for j in range(k_hi):
+            m = key == tbl[rows[0], j]
+            slot = jnp.where(m, j, slot)
+            for i, rsel in enumerate(rows[1:], start=1):
+                outs[i] = jnp.where(m, tbl[rsel, j], outs[i])
+        return slot, outs
+
+    # ---- applied splits: relabel rows of split leaves
+    slotA, aout = chain(
+        lor, [_T_APP_LEAF, _T_APP_FEAT, _T_APP_THR, _T_APP_DL,
+              _T_APP_MT, _T_APP_DB, _T_APP_NB], K)
+    featA, thrA, dlA, mtA, dbA, nbA = aout[1:]
+    featA = jnp.where(slotA >= 0, featA, -1)
+
+    colA = zero
+    for f in range(F):
+        binv = x_ref[f, :].astype(jnp.int32) & 0xFF
+        colA = jnp.where(featA == f, binv, colA)
+    missA = ((mtA == _MT_ZERO) & (colA == dbA)) | \
+            ((mtA == _MT_NAN) & (colA == nbA - 1))
+    # go-left flags stay i32: Mosaic cannot select between i1 vectors
+    glA = jnp.where(missA, dlA, (colA <= thrA).astype(jnp.int32))
+    inA = slotA >= 0
+    nl0 = tbl[_T_NL0, 0]
+    new_lor = jnp.where(inA & (glA == 0), nl0 + slotA, lor)
+    newlor_ref[0, :] = new_lor
+
+    # ---- candidate membership on the post-apply leaf
+    slotC, couts = chain(
+        new_lor, [_T_CAND_LEAF, _T_CAND_FEAT, _T_CAND_THR, _T_CAND_DL,
+                  _T_CAND_MT, _T_CAND_DB, _T_CAND_NB, _T_CAND_SIL], K)
+    featC, thrC, dlC, mtC, dbC, nbC, silC = couts[1:]
+    featC = jnp.where(slotC >= 0, featC, -1)
+    colC = zero
+    for f in range(F):
+        binv = x_ref[f, :].astype(jnp.int32) & 0xFF
+        colC = jnp.where(featC == f, binv, colC)
+    missC = ((mtC == _MT_ZERO) & (colC == dbC)) | \
+            ((mtC == _MT_NAN) & (colC == nbC - 1))
+    glC = jnp.where(missC, dlC, (colC <= thrC).astype(jnp.int32))
+    in_small = (slotC >= 0) & (glC == silC)
+    sl = jnp.where(in_small, slotC, -1)
+
+    # ---- slot histogram (shared contraction body)
+    w_dtype = jnp.int8 if quantized else jnp.bfloat16
+    acc_dtype = jnp.int32 if quantized else jnp.float32
+    W = _slot_mask_W(v_ref[...], sl, K, w_dtype)           # [K*C, R]
+    _slot_hist_contract(x_ref, out_ref, W, K=K, C=C, B=B, LO=LO,
+                        HB=B // LO, acc_dtype=acc_dtype, w_dtype=w_dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_slots", "num_bins", "interpret"))
+def wave_pass_pallas(
+    X_binned_t: jnp.ndarray,   # [F, N] int8/uint8 (feature-major, F <= 32)
+    vals: jnp.ndarray,         # [C, N] f32 (bag-masked) or int8 (quantized)
+    leaf_of_row: jnp.ndarray,  # [N] int32
+    table: jnp.ndarray,        # [T_ROWS, 128] int32 packed wave table
+    num_slots: int,
+    num_bins: int,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused wave pass: returns (new_leaf_of_row [N] i32,
+    hist [K, C, F, num_bins]). X/vals may be pre-padded (F to 32, rows to
+    a block multiple) by the caller so the pad/convert cost is paid once
+    per tree instead of once per wave; `leaf_of_row` keeps the true row
+    count and the outputs are sliced to it."""
+    F, NX = X_binned_t.shape
+    C = vals.shape[0]
+    N = leaf_of_row.shape[0]
+    K = num_slots
+    quantized = vals.dtype == jnp.int8
+    B, LO, HB = _compute_dims(num_bins)
+    assert F <= 32, "wave megakernel requires F <= 32 storage columns"
+    Fp = 32
+    n_blk = N_BLK if NX >= N_BLK else max(_round_up(NX, 256), 256)
+    Np = _round_up(NX, n_blk)
+
+    X = X_binned_t.astype(jnp.int8)
+    if Fp != F or Np != NX:
+        X = jnp.pad(X, ((0, Fp - F), (0, Np - NX)))
+    v = vals if quantized else vals.astype(jnp.float32)
+    if v.shape[1] != Np:
+        v = jnp.pad(v, ((0, 0), (0, Np - v.shape[1])))
+    lor = leaf_of_row.astype(jnp.int32)
+    if Np != N:
+        lor = jnp.pad(lor, (0, Np - N), constant_values=-1)
+
+    out_dtype = jnp.int32 if quantized else jnp.float32
+    grid = (Np // n_blk,)
+    kernel = functools.partial(_wave_kernel, K=K, C=C, B=B, LO=LO, F=F,
+                               quantized=quantized)
+    newlor, out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((Fp, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((T_ROWS, 128), lambda n: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, C, Fp, B), lambda n: (0, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, Np), jnp.int32),
+            jax.ShapeDtypeStruct((K, C, Fp, B), out_dtype),
+        ],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * K * C * Fp * Np * B,
+            bytes_accessed=Fp * Np + (C * 4 + 8) * Np + K * C * Fp * B * 4,
+            transcendentals=0,
+        ),
+    )(X, v, lor[None, :], table)
+
+    return newlor[0, :N], out[:, :, :F, :num_bins]
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "interpret"))

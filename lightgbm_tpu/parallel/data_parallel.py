@@ -60,7 +60,9 @@ def build_data_parallel_train_fn(mesh: jax.sharding.Mesh,
             kw["rng_seed"] = seed
         tree, leaf_of_row = grow_fn(X_t, grad, hess, in_bag, meta, cfg,
                                     **kw)
-        new_scores = scores_k + (tree.leaf_value * lr)[leaf_of_row]
+        from ..ops.histogram import take_leaf_values
+        new_scores = scores_k + take_leaf_values(tree.leaf_value * lr,
+                                                 leaf_of_row)
         return tree, leaf_of_row, new_scores
 
     row = P(DATA_AXIS)

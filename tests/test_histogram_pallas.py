@@ -94,3 +94,163 @@ def test_masked_rows_contribute_nothing():
                                jnp.asarray(vals[:, mask > 0]), B)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
                                rtol=0, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Wave megakernel (fused relabel + candidate membership + slot histogram)
+# and the leaf-value one-hot gather — interpret-mode parity with numpy
+# references implementing the portable-path semantics (grow_wave.py
+# table_go_left).
+# ---------------------------------------------------------------------------
+
+MT_NONE, MT_ZERO, MT_NAN = 0, 1, 2
+
+
+def _ref_go_left(col, thr, dleft, mt, db, nb):
+    missing = ((mt == MT_ZERO) & (col == db)) | \
+              ((mt == MT_NAN) & (col == nb - 1))
+    return np.where(missing, dleft, col <= thr)
+
+
+def _ref_wave_pass(X, vals, lor, tbl, K, B):
+    """Numpy reference for _wave_kernel: relabel rows of applied splits,
+    then candidate smaller-child membership on the new leaf, then the
+    slot histogram."""
+    F, N = X.shape
+    C = vals.shape[0]
+    (a_leaf, a_feat, a_thr, a_dl, a_mt, a_db, a_nb,
+     c_leaf, c_feat, c_thr, c_dl, c_mt, c_db, c_nb, c_sil, nl0r) = tbl
+    nl0 = nl0r[0]
+    new_lor = lor.copy()
+    slot_small = np.full(N, -1, np.int64)
+    for r in range(N):
+        sA = -1
+        for j in range(K):
+            if a_leaf[j] == lor[r]:
+                sA = j
+        if sA >= 0:
+            col = int(X[a_feat[sA], r])
+            gl = _ref_go_left(col, a_thr[sA], a_dl[sA], a_mt[sA],
+                              a_db[sA], a_nb[sA])
+            if not gl:
+                new_lor[r] = nl0 + sA
+        sC = -1
+        for j in range(K):
+            if c_leaf[j] == new_lor[r]:
+                sC = j
+        if sC >= 0:
+            col = int(X[c_feat[sC], r])
+            gl = _ref_go_left(col, c_thr[sC], c_dl[sC], c_mt[sC],
+                              c_db[sC], c_nb[sC])
+            if int(gl) == c_sil[sC]:
+                slot_small[r] = sC
+    hist = np.zeros((K, C, F, B), np.float64)
+    for r in range(N):
+        if slot_small[r] >= 0:
+            for f in range(F):
+                hist[slot_small[r], :, f, X[f, r]] += vals[:, r]
+    return new_lor, hist
+
+
+def test_wave_pass_matches_reference():
+    from lightgbm_tpu.ops.histogram_pallas import wave_pass_pallas
+    rng = np.random.RandomState(3)
+    F, N, B, K = 9, 2000, 64, 8
+    X = rng.randint(0, B - 1, size=(F, N)).astype(np.uint8)
+    vals = _bf16_exact_vals(rng, 2, N)
+    lor = rng.randint(0, 12, size=N).astype(np.int32)
+
+    def slot_tbl(leaves):
+        feat = rng.randint(0, F, size=K)
+        thr = rng.randint(0, B - 2, size=K)
+        dl = rng.randint(0, 2, size=K)
+        mt = rng.choice([MT_NONE, MT_ZERO, MT_NAN], size=K)
+        db = rng.randint(0, B - 1, size=K)
+        nb = np.full(K, B - 1)
+        return leaves, feat, thr, dl, mt, db, nb
+
+    app = slot_tbl(np.array([0, 3, 5, 7, -1, -1, -1, -1]))
+    # candidates: mix of surviving leaves and fresh right children (12+j)
+    cand = slot_tbl(np.array([0, 12, 3, 13, 9, 11, -1, -1]))
+    sil = rng.randint(0, 2, size=K)
+    nl0 = np.full(K, 12)
+    tbl = [*app, *cand, sil, nl0]
+    tbl_np = np.stack([np.asarray(t, np.int32) for t in tbl])
+    tbl16 = jnp.asarray(np.pad(tbl_np, ((0, 0), (0, 128 - K))))
+
+    ref_lor, ref_hist = _ref_wave_pass(X, vals, lor, tbl, K, B)
+    got_lor, got_hist = wave_pass_pallas(
+        jnp.asarray(X), jnp.asarray(vals), jnp.asarray(lor), tbl16, K, B,
+        interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_lor), ref_lor)
+    np.testing.assert_allclose(np.asarray(got_hist), ref_hist,
+                               rtol=0, atol=1e-6)
+
+
+def test_wave_pass_quantized_int8_exact():
+    from lightgbm_tpu.ops.histogram_pallas import wave_pass_pallas
+    rng = np.random.RandomState(4)
+    F, N, B, K = 5, 1200, 32, 4
+    X = rng.randint(0, B - 1, size=(F, N)).astype(np.uint8)
+    vals = rng.randint(-127, 128, size=(2, N)).astype(np.int8)
+    lor = rng.randint(0, 6, size=N).astype(np.int32)
+    app = (np.array([1, 4, -1, -1]), np.array([0, 2, 0, 0]),
+           np.array([10, 20, 0, 0]), np.array([0, 1, 0, 0]),
+           np.array([MT_NONE] * 4), np.zeros(4, int), np.full(4, B - 1))
+    cand = (np.array([1, 6, 4, 7]), np.array([1, 3, 2, 4]),
+            np.array([5, 15, 25, 8]), np.array([1, 0, 0, 1]),
+            np.array([MT_NONE] * 4), np.zeros(4, int), np.full(4, B - 1))
+    sil = np.array([1, 0, 1, 0])
+    nl0 = np.full(4, 6)
+    tbl = [*app, *cand, sil, nl0]
+    tbl_np = np.stack([np.asarray(t, np.int32) for t in tbl])
+    tbl16 = jnp.asarray(np.pad(tbl_np, ((0, 0), (0, 128 - K))))
+    ref_lor, ref_hist = _ref_wave_pass(X, vals.astype(np.int64), lor, tbl,
+                                       K, B)
+    got_lor, got_hist = wave_pass_pallas(
+        jnp.asarray(X), jnp.asarray(vals), jnp.asarray(lor), tbl16, K, B,
+        interpret=True)
+    assert got_hist.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(got_lor), ref_lor)
+    np.testing.assert_array_equal(np.asarray(got_hist), ref_hist)
+
+
+def test_wave_pass_prepadded_inputs():
+    """Caller-side pre-padding (F to 32, rows to a block multiple) must
+    give identical results to unpadded inputs."""
+    from lightgbm_tpu.ops.histogram_pallas import wave_pass_pallas
+    rng = np.random.RandomState(5)
+    F, N, B, K = 6, 700, 32, 2
+    X = rng.randint(0, B - 1, size=(F, N)).astype(np.uint8)
+    vals = _bf16_exact_vals(rng, 2, N)
+    lor = rng.randint(0, 4, size=N).astype(np.int32)
+    tblr = [np.array([0, 2]), np.array([1, 3]), np.array([4, 9]),
+            np.array([0, 1]), np.array([MT_NONE] * 2), np.zeros(2, int),
+            np.full(2, B - 1),
+            np.array([4, 2]), np.array([2, 0]), np.array([7, 3]),
+            np.array([1, 0]), np.array([MT_NONE] * 2), np.zeros(2, int),
+            np.full(2, B - 1), np.array([1, 0]), np.full(2, 4)]
+    tbl_np = np.stack([np.asarray(t, np.int32) for t in tblr])
+    tbl16 = jnp.asarray(np.pad(tbl_np, ((0, 0), (0, 126))))
+    lor_j = jnp.asarray(lor)
+    got1 = wave_pass_pallas(jnp.asarray(X), jnp.asarray(vals), lor_j,
+                            tbl16, K, B, interpret=True)
+    Np = 1024
+    Xp = jnp.asarray(np.pad(X.astype(np.int8), ((0, 32 - F), (0, Np - N))))
+    vp = jnp.asarray(np.pad(vals, ((0, 0), (0, Np - N))))
+    got2 = wave_pass_pallas(Xp, vp, lor_j, tbl16, K, B, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got1[0]), np.asarray(got2[0]))
+    np.testing.assert_allclose(np.asarray(got1[1]),
+                               np.asarray(got2[1][:, :, :F, :]),
+                               rtol=0, atol=1e-6)
+
+
+def test_take_leaf_values_exact():
+    from lightgbm_tpu.ops.histogram_pallas import take_leaf_values_pallas
+    rng = np.random.RandomState(6)
+    for L, N in ((255, 5000), (31, 300), (1024, 2000)):
+        vals = rng.normal(size=L).astype(np.float32)
+        lor = rng.randint(0, L, size=N).astype(np.int32)
+        got = take_leaf_values_pallas(jnp.asarray(vals), jnp.asarray(lor),
+                                      interpret=True)
+        np.testing.assert_array_equal(np.asarray(got), vals[lor])
