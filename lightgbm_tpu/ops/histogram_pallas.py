@@ -77,8 +77,8 @@ def _slot_hist_contract(x_ref, out_ref, W, *, K, C, B, LO, HB, acc_dtype,
     output lanes, so G = 128/LO features are packed side by side per
     contraction (full 128-lane output tiles)."""
     R = x_ref.shape[1]
-    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (LO, R), 0)
     G = max(128 // LO, 1) if HB == 1 else 1
+    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (LO, R), 0)
 
     for f0 in range(0, x_ref.shape[0], G):
         if HB == 1:
