@@ -13,7 +13,7 @@ Public API mirrors the reference python package:
     pred = bst.predict(X_test)
 """
 
-from .basic import Booster, Dataset
+from .basic import Booster, Dataset, Sequence
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config, resolve_params
@@ -23,7 +23,7 @@ from .utils.log import register_logger
 __version__ = "0.1.0"
 
 __all__ = [
-    "Dataset", "Booster", "train", "cv", "CVBooster",
+    "Dataset", "Booster", "Sequence", "train", "cv", "CVBooster",
     "Config", "resolve_params",
     "early_stopping", "log_evaluation", "record_evaluation",
     "reset_parameter", "EarlyStopException",

@@ -10,25 +10,90 @@ reference dataset so validation bins align, basic.py _lazy_init).
 from __future__ import annotations
 
 import copy
-from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
+import os
+from typing import Any, Dict, Iterable, List, Optional, Union
 
 import numpy as np
 
 from .config import Config, resolve_params
-from .data.dataset import BinnedDataset, construct_from_matrix
+from .data.dataset import (BinnedDataset, construct_from_matrix,
+                           construct_from_sequences, load_binary_file)
 from .metrics import Metric, create_metric, default_metric_for_objective
 from .models.gbdt import GBDT
 from .objectives import create_objective
 from .utils.log import log_fatal, log_info, log_warning
 
 
+def _is_arrow(data: Any) -> bool:
+    mod = type(data).__module__
+    return mod.startswith("pyarrow")
+
+
+def _is_scipy_sparse(data: Any) -> bool:
+    return type(data).__module__.startswith("scipy.sparse")
+
+
+def _arrow_to_numpy(data: Any) -> np.ndarray:
+    """Arrow Table/RecordBatch/Array -> float64 matrix (reference:
+    Arrow C-data ingestion, include/LightGBM/arrow.h:50,
+    LGBM_DatasetCreateFromArrowStream c_api.h:477 — here the pyarrow
+    objects are consumed directly; zero-copy per column when the type
+    allows)."""
+    import pyarrow as pa
+    if isinstance(data, pa.RecordBatch):
+        data = pa.Table.from_batches([data])
+    if isinstance(data, pa.Table):
+        cols = [np.asarray(c.to_numpy(zero_copy_only=False), np.float64)
+                for c in data.columns]
+        return np.column_stack(cols) if cols else np.zeros((0, 0))
+    if isinstance(data, (pa.Array, pa.ChunkedArray)):
+        return np.asarray(data.to_numpy(zero_copy_only=False),
+                          np.float64).reshape(-1, 1)
+    raise TypeError(f"Unsupported pyarrow input type {type(data)}")
+
+
+def _to_1d_numpy(v: Any) -> np.ndarray:
+    """Label/weight/init_score coercion incl. Arrow arrays (reference:
+    Metadata Arrow setters, dataset.h:49-134)."""
+    if _is_arrow(v):
+        return _arrow_to_numpy(v).reshape(-1)
+    return np.asarray(v).reshape(-1)
+
+
 def _to_2d_numpy(data: Any) -> np.ndarray:
+    if _is_arrow(data):
+        return _arrow_to_numpy(data)
+    if _is_scipy_sparse(data):
+        # prediction-sized batches; Dataset construction routes sparse
+        # through construct_from_sparse and never reaches here
+        return np.asarray(data.todense())
     if hasattr(data, "values"):   # pandas DataFrame
         data = data.values
     arr = np.asarray(data)
     if arr.ndim == 1:
         arr = arr.reshape(-1, 1)
     return arr
+
+
+class Sequence:
+    """Generic data access interface for out-of-core ingestion
+    (reference: basic.py:841). Subclass with:
+
+      * ``__len__()`` — number of rows
+      * ``__getitem__(idx)`` — a row for an int, a 2-D batch for a slice
+
+    and optionally set ``batch_size`` (rows fetched per binning batch).
+    Pass an instance (or a list of instances, concatenated in order) as
+    ``Dataset(data=...)``: construction samples rows for binning, then
+    streams batches — the full raw matrix is never materialized."""
+
+    batch_size: int = 65536
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
 
 
 class Dataset:
@@ -58,43 +123,147 @@ class Dataset:
         if self._handle is not None:
             return self
         cfg = resolve_params(self.params)
+
+        # file-path data: binary cache (npz/zip magic) or text
+        # (reference: Dataset(data=<path>) routes through DatasetLoader,
+        # LoadFromBinFile when the signature matches, dataset_loader.h:53)
+        if isinstance(self.data, (str, os.PathLike)):
+            path = os.fspath(self.data)
+            with open(path, "rb") as f:
+                magic = f.read(4)
+            if magic[:2] == b"PK":
+                self._handle = load_binary_file(path, cfg)
+                for setter, val in ((self._handle.metadata.set_label,
+                                     self.label),
+                                    (self._handle.metadata.set_weight,
+                                     self.weight)):
+                    if val is not None:
+                        setter(np.asarray(val))
+                if self.group is not None:
+                    self._handle.metadata.set_group(np.asarray(self.group))
+                if self.init_score is not None:
+                    self._handle.metadata.set_init_score(
+                        _to_1d_numpy(self.init_score))
+                if self.free_raw_data:
+                    self.data = None
+                return self
+            from .data.loader import load_text_file
+            X, y, w, g, names = load_text_file(
+                path, has_header=cfg.header,
+                label_column=cfg.label_column,
+                weight_column=cfg.weight_column,
+                group_column=cfg.group_column,
+                ignore_column=cfg.ignore_column)
+            self.data = X
+            if self.label is None and y is not None:
+                self.label = y
+            if self.weight is None and w is not None:
+                self.weight = w
+            if self.group is None and g is not None:
+                self.group = g
+            if self.feature_name == "auto" and names:
+                self.feature_name = names
+
+        # out-of-core Sequence source(s) (reference: basic.py:841)
+        seqs = None
+        if isinstance(self.data, Sequence):
+            seqs = [self.data]
+        elif isinstance(self.data, (list, tuple)) and self.data \
+                and all(isinstance(s, Sequence) for s in self.data):
+            seqs = list(self.data)
+        if seqs is not None:
+            return self._construct_from_seqs(seqs, cfg)
+
+        # scipy sparse: column-streamed construction, never densified
+        if _is_scipy_sparse(self.data):
+            from .data.dataset import construct_from_sparse
+            feature_names = (list(self.feature_name)
+                             if isinstance(self.feature_name, list)
+                             else None)
+            ref_handle = None
+            if self.reference is not None:
+                self.reference.construct()
+                ref_handle = self.reference._handle
+            self._handle = construct_from_sparse(
+                self.data, cfg,
+                label=(None if self.label is None
+                       else _to_1d_numpy(self.label)),
+                weight=(None if self.weight is None
+                        else _to_1d_numpy(self.weight)),
+                group=(None if self.group is None
+                       else _to_1d_numpy(self.group)),
+                init_score=(None if self.init_score is None
+                            else _to_1d_numpy(self.init_score)),
+                categorical_feature=self._cat_indices(feature_names),
+                feature_names=feature_names, reference=ref_handle)
+            if self.free_raw_data:
+                self.data = None
+            return self
+
         data = _to_2d_numpy(self.data)
         n_cols = data.shape[1]
 
         feature_names: Optional[List[str]] = None
         if isinstance(self.feature_name, list):
             feature_names = list(self.feature_name)
-        elif hasattr(self.data, "columns"):
+        elif _is_arrow(self.data) and hasattr(self.data, "column_names"):
+            feature_names = list(self.data.column_names)
+        elif hasattr(self.data, "columns") \
+                and not _is_arrow(self.data):
             feature_names = [str(c) for c in self.data.columns]
 
-        cat_indices: List[int] = []
-        cats = self.categorical_feature
-        if cats == "auto" or cats is None:
-            cat_indices = []
-        elif isinstance(cats, str):
-            cat_indices = [int(c) for c in cats.split(",") if c]
-        else:
-            for c in cats:
-                if isinstance(c, str):
-                    if feature_names and c in feature_names:
-                        cat_indices.append(feature_names.index(c))
-                else:
-                    cat_indices.append(int(c))
+        cat_indices = self._cat_indices(feature_names)
 
         ref_handle = None
         if self.reference is not None:
             self.reference.construct()
             ref_handle = self.reference._handle
 
-        label = None if self.label is None else np.asarray(self.label)
-        weight = None if self.weight is None else np.asarray(self.weight)
-        group = None if self.group is None else np.asarray(self.group)
-        init_score = None if self.init_score is None else np.asarray(
+        label = None if self.label is None else _to_1d_numpy(self.label)
+        weight = None if self.weight is None else _to_1d_numpy(self.weight)
+        group = None if self.group is None else _to_1d_numpy(self.group)
+        init_score = None if self.init_score is None else _to_1d_numpy(
             self.init_score)
 
         self._handle = construct_from_matrix(
             data, cfg, label=label, weight=weight, group=group,
             init_score=init_score, categorical_feature=cat_indices,
+            feature_names=feature_names, reference=ref_handle)
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    def _cat_indices(self, feature_names: Optional[List[str]]) -> List[int]:
+        cats = self.categorical_feature
+        if cats == "auto" or cats is None:
+            return []
+        if isinstance(cats, str):
+            return [int(c) for c in cats.split(",") if c]
+        out: List[int] = []
+        for c in cats:
+            if isinstance(c, str):
+                if feature_names and c in feature_names:
+                    out.append(feature_names.index(c))
+            else:
+                out.append(int(c))
+        return out
+
+    def _construct_from_seqs(self, seqs: List["Sequence"],
+                             cfg: Config) -> "Dataset":
+        feature_names = (list(self.feature_name)
+                         if isinstance(self.feature_name, list) else None)
+        ref_handle = None
+        if self.reference is not None:
+            self.reference.construct()
+            ref_handle = self.reference._handle
+        self._handle = construct_from_sequences(
+            seqs, cfg,
+            label=None if self.label is None else np.asarray(self.label),
+            weight=None if self.weight is None else np.asarray(self.weight),
+            group=None if self.group is None else np.asarray(self.group),
+            init_score=(None if self.init_score is None
+                        else np.asarray(self.init_score)),
+            categorical_feature=self._cat_indices(feature_names),
             feature_names=feature_names, reference=ref_handle)
         if self.free_raw_data:
             self.data = None
@@ -166,27 +335,114 @@ class Dataset:
                 None if init_score is None else np.asarray(init_score))
         return self
 
+    # -- streaming push ingestion --------------------------------------
+    def init_streaming(self, num_rows: int,
+                       reference: Optional["Dataset"] = None) -> "Dataset":
+        """Incremental row-push construction against a reference's bin
+        mappers (reference: LGBM_DatasetInitStreaming c_api.cpp:1125 +
+        LGBM_DatasetPushRows* c_api.h:221-324; streaming requires the
+        schema/mappers up front, normally from a serialized reference).
+        Falls back to `self.reference` when `reference` is None."""
+        ref = reference if reference is not None else self.reference
+        if ref is None:
+            log_fatal("init_streaming requires a reference Dataset "
+                      "carrying the bin mappers")
+        ref.construct()
+        rh = ref._handle
+        h = BinnedDataset()
+        h.num_data = int(num_rows)
+        h.num_total_features = rh.num_total_features
+        h.mappers = rh.mappers
+        h.real_feature_index = rh.real_feature_index
+        h.used_feature_map = rh.used_feature_map
+        h.feature_names = list(rh.feature_names)
+        h.max_bin = rh.max_bin
+        h.reference = rh
+        h.X_binned = np.zeros((num_rows, max(len(rh.mappers), 1)),
+                              dtype=rh.X_binned.dtype)
+        from .data.dataset import Metadata
+        md = Metadata(num_rows)
+        md.set_label(np.zeros(num_rows, np.float32))
+        h.metadata = md
+        self._handle = h
+        self._stream_pos = 0
+        return self
+
+    def push_rows(self, data, label=None, weight=None, init_score=None,
+                  start_row: Optional[int] = None) -> "Dataset":
+        """Push a batch of raw rows into a streaming dataset, binning
+        against the reference mappers (LGBM_DatasetPushRowsWithMetadata
+        semantics; single-writer — the reference's C API allows
+        concurrent pushers, here pushes are sequential)."""
+        h = self._handle
+        if h is None or not hasattr(self, "_stream_pos"):
+            log_fatal("push_rows requires init_streaming first")
+        batch = _to_2d_numpy(data)
+        n = batch.shape[0]
+        lo = self._stream_pos if start_row is None else int(start_row)
+        hi = lo + n
+        if hi > h.num_data:
+            log_fatal(f"push_rows overflows the dataset "
+                      f"({hi} > {h.num_data})")
+        for inner, (m, orig) in enumerate(zip(h.mappers,
+                                              h.real_feature_index)):
+            h.X_binned[lo:hi, inner] = m.value_to_bin(
+                np.asarray(batch[:, orig], np.float64))
+        if label is not None:
+            h.metadata.label[lo:hi] = _to_1d_numpy(label)
+        if weight is not None:
+            if h.metadata.weight is None:
+                h.metadata.set_weight(np.ones(h.num_data, np.float32))
+            h.metadata.weight[lo:hi] = _to_1d_numpy(weight)
+        if init_score is not None:
+            if h.metadata.init_score is None:
+                h.metadata.set_init_score(np.zeros(h.num_data, np.float64))
+            h.metadata.init_score[lo:hi] = _to_1d_numpy(init_score)
+        if start_row is None:
+            self._stream_pos = hi
+        else:
+            self._stream_pos = max(self._stream_pos, hi)
+        return self
+
+    def mark_finished(self) -> "Dataset":
+        """End of streaming pushes (LGBM_DatasetMarkFinished)."""
+        if not hasattr(self, "_stream_pos"):
+            log_fatal("mark_finished requires init_streaming first")
+        if self._stream_pos < self._handle.num_data:
+            log_warning(f"streaming dataset finished at row "
+                        f"{self._stream_pos} of {self._handle.num_data}")
+        del self._stream_pos
+        return self
+
     def save_binary(self, filename: str) -> "Dataset":
         """Binary dataset cache (reference: LGBM_DatasetSaveBinary,
         c_api.h:540). Stored as an npz with mapper metadata."""
-        import json
         self.construct()
         h = self._handle
+        # pass a file object: savez would otherwise append ".npz"
+        with open(filename, "wb") as fout:
+            self._write_binary(fout, h)
+        return self
+
+    def _write_binary(self, fout, h) -> None:
+        import json
         np.savez_compressed(
-            filename,
+            fout,
             X_binned=h.X_binned,
             label=h.metadata.label if h.metadata.label is not None else np.zeros(0),
             weight=h.metadata.weight if h.metadata.weight is not None else np.zeros(0),
             query_boundaries=(h.metadata.query_boundaries
                               if h.metadata.query_boundaries is not None
                               else np.zeros(0)),
+            init_score=(h.metadata.init_score
+                        if h.metadata.init_score is not None
+                        else np.zeros(0)),
             mappers=json.dumps([m.to_dict() for m in h.mappers]),
             real_feature_index=np.asarray(h.real_feature_index),
             used_feature_map=np.asarray(h.used_feature_map),
             feature_names=json.dumps(h.feature_names),
             num_total_features=h.num_total_features,
         )
-        return self
 
 
 class Booster:
@@ -375,9 +631,16 @@ class Booster:
         if pred_contrib:
             from .models.shap import predict_contrib
             return predict_contrib(self._gbdt, data, start_iteration, ni)
+        es_kwargs = {}
+        for p in ("pred_early_stop", "pred_early_stop_freq",
+                  "pred_early_stop_margin"):
+            if p in kwargs:
+                es_kwargs[p] = kwargs[p]
+            elif p in self.params:
+                es_kwargs[p] = self.params[p]
         return self._gbdt.predict(data, raw_score=raw_score,
                                   start_iteration=start_iteration,
-                                  num_iteration=ni)
+                                  num_iteration=ni, **es_kwargs)
 
     # ------------------------------------------------------------------
     def save_model(self, filename: str, num_iteration: Optional[int] = None,

@@ -148,6 +148,84 @@ class BinnedDataset:
         return self.metadata.label if self.metadata else None
 
 
+def _init_ds(num_data: int, num_cols: int, config: Config,
+             feature_names: Optional[Sequence[str]]) -> BinnedDataset:
+    ds = BinnedDataset()
+    ds.num_data = int(num_data)
+    ds.num_total_features = int(num_cols)
+    ds.max_bin = config.max_bin
+    ds.feature_names = (list(feature_names) if feature_names is not None
+                        else [f"Column_{i}" for i in range(num_cols)])
+    return ds
+
+
+def _fit_or_adopt_mappers(ds: BinnedDataset, config: Config,
+                          reference: Optional[BinnedDataset],
+                          sample_col, n_sample: int,
+                          categorical_feature: Sequence[int]) -> None:
+    """Bin-mapper construction shared by every constructor: adopt the
+    reference's mappers (Dataset::CreateValid, dataset.h:721) or fit one
+    per column from `sample_col(j)` (DatasetLoader sampling + binning,
+    dataset_loader.cpp:653-707)."""
+    if reference is not None:
+        ds.mappers = reference.mappers
+        ds.real_feature_index = reference.real_feature_index
+        ds.used_feature_map = reference.used_feature_map
+        ds.reference = reference
+        return
+    num_cols = ds.num_total_features
+    cat_set = set(int(c) for c in categorical_feature)
+    max_bins = list(config.max_bin_by_feature) if config.max_bin_by_feature \
+        else [config.max_bin] * num_cols
+    ds.mappers, ds.real_feature_index, ds.used_feature_map = [], [], []
+    for j in range(num_cols):
+        bin_type = (BIN_TYPE_CATEGORICAL if j in cat_set
+                    else BIN_TYPE_NUMERICAL)
+        m = BinMapper.find_bin(
+            sample_col(j), total_sample_cnt=n_sample,
+            max_bin=max_bins[j],
+            min_data_in_bin=config.min_data_in_bin,
+            min_split_data=config.min_data_in_leaf,
+            pre_filter=config.feature_pre_filter,
+            bin_type=bin_type,
+            use_missing=config.use_missing,
+            zero_as_missing=config.zero_as_missing)
+        if m.is_trivial:
+            ds.used_feature_map.append(-1)
+        else:
+            ds.used_feature_map.append(len(ds.mappers))
+            ds.mappers.append(m)
+            ds.real_feature_index.append(j)
+    if not ds.mappers:
+        log_warning("There are no meaningful features which satisfy the "
+                    "provided configuration. Decrease min_data_in_bin or "
+                    "check the data.")
+
+
+def _alloc_binned(ds: BinnedDataset) -> np.ndarray:
+    max_num_bin = max((m.num_bin for m in ds.mappers), default=2)
+    dtype = np.uint8 if max_num_bin <= 256 else np.uint16
+    return np.zeros((ds.num_data, max(len(ds.mappers), 1)), dtype=dtype)
+
+
+def _finalize(ds: BinnedDataset, config: Config,
+              label, weight, group, init_score,
+              reference: Optional[BinnedDataset]) -> BinnedDataset:
+    """Metadata attach + the EFB bundle gate, shared by every
+    constructor."""
+    md = Metadata(ds.num_data)
+    md.set_label(label)
+    md.set_weight(weight)
+    md.set_group(group)
+    md.set_init_score(init_score)
+    ds.metadata = md
+    if (reference is None and config.enable_bundle
+            and config.boosting in ("gbdt", "gbrt")
+            and config.tpu_grower in ("auto", "wave", "wave_exact")):
+        _build_bundles(ds, config)
+    return ds
+
+
 def construct_from_matrix(
     data: np.ndarray,
     config: Config,
@@ -171,79 +249,175 @@ def construct_from_matrix(
     if data.ndim != 2:
         log_fatal("Training data must be 2-dimensional")
     num_data, num_cols = data.shape
-    ds = BinnedDataset()
-    ds.num_data = num_data
-    ds.num_total_features = num_cols
-    ds.max_bin = config.max_bin
+    ds = _init_ds(num_data, num_cols, config, feature_names)
 
-    if feature_names is None:
-        feature_names = [f"Column_{i}" for i in range(num_cols)]
-    ds.feature_names = list(feature_names)
-
-    cat_set = set(int(c) for c in categorical_feature)
-
-    if reference is not None:
-        ds.mappers = reference.mappers
-        ds.real_feature_index = reference.real_feature_index
-        ds.used_feature_map = reference.used_feature_map
-        ds.reference = reference
+    # sample rows for binning (bin_construct_sample_cnt rows,
+    # dataset_loader.cpp:1162)
+    sample_cnt = min(config.bin_construct_sample_cnt, num_data)
+    rng = np.random.RandomState(config.data_random_seed)
+    if sample_cnt < num_data:
+        sample_idx = np.sort(rng.choice(num_data, sample_cnt,
+                                        replace=False))
+        sample = data[sample_idx]
     else:
-        # --- sample rows for binning (loader samples
-        #     bin_construct_sample_cnt rows, dataset_loader.cpp:1162)
-        sample_cnt = min(config.bin_construct_sample_cnt, num_data)
-        rng = np.random.RandomState(config.data_random_seed)
-        if sample_cnt < num_data:
-            sample_idx = np.sort(rng.choice(num_data, sample_cnt, replace=False))
-            sample = data[sample_idx]
-        else:
-            sample = data
-        sample = np.asarray(sample, dtype=np.float64)
+        sample = data
+    sample = np.asarray(sample, dtype=np.float64)
+    _fit_or_adopt_mappers(ds, config, reference,
+                          lambda j: sample[:, j], len(sample),
+                          categorical_feature)
 
-        max_bins = list(config.max_bin_by_feature) if config.max_bin_by_feature \
-            else [config.max_bin] * num_cols
-        ds.mappers = []
-        ds.real_feature_index = []
-        ds.used_feature_map = []
-        for j in range(num_cols):
-            bin_type = BIN_TYPE_CATEGORICAL if j in cat_set else BIN_TYPE_NUMERICAL
-            m = BinMapper.find_bin(
-                sample[:, j], total_sample_cnt=len(sample),
-                max_bin=max_bins[j],
-                min_data_in_bin=config.min_data_in_bin,
-                min_split_data=config.min_data_in_leaf,
-                pre_filter=config.feature_pre_filter,
-                bin_type=bin_type,
-                use_missing=config.use_missing,
-                zero_as_missing=config.zero_as_missing)
-            if m.is_trivial:
-                ds.used_feature_map.append(-1)
-            else:
-                ds.used_feature_map.append(len(ds.mappers))
-                ds.mappers.append(m)
-                ds.real_feature_index.append(j)
-        if not ds.mappers:
-            log_warning("There are no meaningful features which satisfy the "
-                        "provided configuration. Decrease min_data_in_bin or "
-                        "check the data.")
-
-    # --- push rows: vectorized value->bin per feature
-    n_feat = len(ds.mappers)
-    max_num_bin = max((m.num_bin for m in ds.mappers), default=2)
-    dtype = np.uint8 if max_num_bin <= 256 else np.uint16
-    X = np.zeros((num_data, max(n_feat, 1)), dtype=dtype)
+    # push rows: vectorized value->bin per feature
+    X = _alloc_binned(ds)
     for inner, (m, orig) in enumerate(zip(ds.mappers, ds.real_feature_index)):
         col = np.asarray(data[:, orig], dtype=np.float64)
-        X[:, inner] = m.value_to_bin(col).astype(dtype)
+        X[:, inner] = m.value_to_bin(col).astype(X.dtype)
     ds.X_binned = X
+    return _finalize(ds, config, label, weight, group, init_score,
+                     reference)
 
-    md = Metadata(num_data)
-    md.set_label(label)
-    md.set_weight(weight)
-    md.set_group(group)
-    md.set_init_score(init_score)
+
+def construct_from_sequences(
+    seqs,
+    config: Config,
+    label: Optional[np.ndarray] = None,
+    weight: Optional[np.ndarray] = None,
+    group: Optional[np.ndarray] = None,
+    init_score: Optional[np.ndarray] = None,
+    categorical_feature: Sequence[int] = (),
+    feature_names: Optional[Sequence[str]] = None,
+    reference: Optional[BinnedDataset] = None,
+) -> BinnedDataset:
+    """Out-of-core two-round construction from user Sequence sources
+    (reference: python Sequence class basic.py:841 + the loader's
+    two-round/low-memory path, dataset_loader.cpp:1162-1213): round one
+    samples rows for binning, round two streams batches through
+    value_to_bin — peak memory is the 1-byte-per-cell binned matrix plus
+    one raw batch, never the full raw data."""
+    lens = [len(s) for s in seqs]
+    num_data = int(sum(lens))
+    if num_data == 0:
+        log_fatal("Sequence sources are empty")
+    probe = np.asarray(seqs[0][0:1], dtype=np.float64)
+    ds = _init_ds(num_data, probe.shape[1], config, feature_names)
+    starts = np.concatenate([[0], np.cumsum(lens)])
+    b = getattr(seqs[0], "batch_size", None) or 65536
+
+    def fetch(global_lo, global_hi):
+        """Rows [global_lo, global_hi) across the concatenated sources."""
+        parts = []
+        for si, s in enumerate(seqs):
+            lo = max(global_lo, starts[si])
+            hi = min(global_hi, starts[si + 1])
+            if lo < hi:
+                parts.append(np.asarray(
+                    s[int(lo - starts[si]):int(hi - starts[si])],
+                    dtype=np.float64))
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+    if reference is None:
+        # round 1: sample rows (contiguous batched fetches of a random
+        # global index set, dataset_loader.cpp:1162)
+        sample_cnt = min(config.bin_construct_sample_cnt, num_data)
+        rng = np.random.RandomState(config.data_random_seed)
+        idx = np.sort(rng.choice(num_data, sample_cnt, replace=False)) \
+            if sample_cnt < num_data else np.arange(num_data)
+        chunks = []
+        for lo in range(0, num_data, b):
+            sel = idx[(idx >= lo) & (idx < lo + b)]
+            if sel.size:
+                batch = fetch(lo, min(lo + b, num_data))
+                chunks.append(batch[sel - lo])
+        sample = np.concatenate(chunks)
+    else:
+        sample = probe
+    _fit_or_adopt_mappers(ds, config, reference,
+                          lambda j: sample[:, j], len(sample),
+                          categorical_feature)
+
+    # round 2: stream batches through the mappers
+    X = _alloc_binned(ds)
+    for lo in range(0, num_data, b):
+        hi = min(lo + b, num_data)
+        batch = fetch(lo, hi)
+        for inner, (m, orig) in enumerate(
+                zip(ds.mappers, ds.real_feature_index)):
+            X[lo:hi, inner] = m.value_to_bin(batch[:, orig]).astype(X.dtype)
+    ds.X_binned = X
+    return _finalize(ds, config, label, weight, group, init_score,
+                     reference)
+
+
+def construct_from_sparse(
+    data,
+    config: Config,
+    label: Optional[np.ndarray] = None,
+    weight: Optional[np.ndarray] = None,
+    group: Optional[np.ndarray] = None,
+    init_score: Optional[np.ndarray] = None,
+    categorical_feature: Sequence[int] = (),
+    feature_names: Optional[Sequence[str]] = None,
+    reference: Optional[BinnedDataset] = None,
+) -> BinnedDataset:
+    """Build from a scipy CSR/CSC matrix without densifying it: one raw
+    column is materialized at a time (absent entries are 0, matching the
+    reference's sparse semantics, sparse_bin.hpp; storage compression of
+    the BINNED matrix comes from EFB bundling, dataset.cpp:251)."""
+    num_data, num_cols = data.shape
+    ds = _init_ds(num_data, num_cols, config, feature_names)
+    csc = data.tocsc()
+
+    if reference is None:
+        sample_cnt = min(config.bin_construct_sample_cnt, num_data)
+        rng = np.random.RandomState(config.data_random_seed)
+        idx = np.sort(rng.choice(num_data, sample_cnt, replace=False)) \
+            if sample_cnt < num_data else np.arange(num_data)
+        sample = data.tocsr()[idx].tocsc()
+        n_sample = len(idx)
+    else:
+        sample, n_sample = None, 0
+    _fit_or_adopt_mappers(
+        ds, config, reference,
+        lambda j: np.asarray(sample[:, j].todense(), np.float64).ravel(),
+        n_sample, categorical_feature)
+
+    X = _alloc_binned(ds)
+    for inner, (m, orig) in enumerate(zip(ds.mappers,
+                                          ds.real_feature_index)):
+        col = np.asarray(csc[:, orig].todense(), np.float64).ravel()
+        X[:, inner] = m.value_to_bin(col).astype(X.dtype)
+    ds.X_binned = X
+    return _finalize(ds, config, label, weight, group, init_score,
+                     reference)
+
+
+def load_binary_file(path: str, config: Config) -> BinnedDataset:
+    """Load a binary dataset cache written by Dataset.save_binary
+    (reference: DatasetLoader::LoadFromBinFile, dataset_loader.h:53 —
+    skips sampling/binning entirely; the mappers ride in the file)."""
+    import json
+    from .binning import BinMapper
+    z = np.load(path, allow_pickle=False)
+    ds = BinnedDataset()
+    ds.X_binned = z["X_binned"]
+    ds.num_data = int(ds.X_binned.shape[0])
+    ds.mappers = [BinMapper.from_dict(d)
+                  for d in json.loads(str(z["mappers"]))]
+    ds.real_feature_index = [int(v) for v in z["real_feature_index"]]
+    ds.used_feature_map = [int(v) for v in z["used_feature_map"]]
+    ds.feature_names = json.loads(str(z["feature_names"]))
+    ds.num_total_features = int(z["num_total_features"])
+    ds.max_bin = config.max_bin
+    md = Metadata(ds.num_data)
+    if z["label"].size:
+        md.set_label(z["label"])
+    if z["weight"].size:
+        md.set_weight(z["weight"])
+    if z["query_boundaries"].size:
+        md.query_boundaries = np.asarray(z["query_boundaries"], np.int64)
+    if "init_score" in z.files and z["init_score"].size:
+        md.set_init_score(z["init_score"])
     ds.metadata = md
-    if (reference is None and config.enable_bundle
-            and config.boosting in ("gbdt", "gbrt")
+    if (config.enable_bundle and config.boosting in ("gbdt", "gbrt")
             and config.tpu_grower in ("auto", "wave", "wave_exact")):
         _build_bundles(ds, config)
     return ds

@@ -976,25 +976,66 @@ class GBDT:
     # ------------------------------------------------------------------
     # prediction (host trees; raw features)
     # ------------------------------------------------------------------
+    def _packed_model(self, start_iteration: int, end: int):
+        """Cached PackedModel for the [start_iteration, end) tree slice
+        (the single/batch fast-path init, c_api.h:1399 FastInit analog)."""
+        key = (start_iteration, end, len(self.models))
+        cached = getattr(self, "_packed_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        from .predictor import PackedModel
+        K = self.num_tree_per_iteration
+        pm = PackedModel(self.models[start_iteration * K:end * K], K)
+        self._packed_cache = (key, pm)
+        return pm
+
     def predict_raw(self, X: np.ndarray, start_iteration: int = 0,
-                    num_iteration: int = -1) -> np.ndarray:
+                    num_iteration: int = -1,
+                    pred_early_stop: bool = False,
+                    pred_early_stop_freq: int = 10,
+                    pred_early_stop_margin: float = 10.0) -> np.ndarray:
         X = np.asarray(X, dtype=np.float64)
         K = self.num_tree_per_iteration
         total_iters = len(self.models) // K
         end = total_iters if num_iteration <= 0 else min(
             total_iters, start_iteration + num_iteration)
-        out = np.zeros((K, X.shape[0]), dtype=np.float64)
-        for it in range(start_iteration, end):
-            for k in range(K):
-                out[k] += self.models[it * K + k].predict(X)
+        if end <= start_iteration:
+            return np.zeros((K, X.shape[0]), dtype=np.float64)
+        pm = self._packed_model(start_iteration, end)
+        # early stop is margin-based and meaningless for averaged (RF)
+        # output (prediction_early_stop.cpp operates on boosted margins)
+        margin = (pred_early_stop_margin
+                  if pred_early_stop and not self.average_output else None)
+        # freq counts ITERATIONS (each covering all K class trees), as in
+        # the reference's per-iteration early-stop counter
+        out = pm.predict_margin(X, early_stop_margin=margin,
+                                early_stop_freq=max(
+                                    1, int(pred_early_stop_freq)))
         if self.average_output and end > start_iteration:
             out /= (end - start_iteration)
         return out
 
+    def predict_single_row(self, x: np.ndarray, start_iteration: int = 0,
+                           num_iteration: int = -1) -> np.ndarray:
+        """One-row fast path over the cached packed trees ([K] margins;
+        LGBM_BoosterPredictForMatSingleRowFast semantics)."""
+        K = self.num_tree_per_iteration
+        total_iters = len(self.models) // K
+        end = total_iters if num_iteration <= 0 else min(
+            total_iters, start_iteration + num_iteration)
+        if end <= start_iteration:
+            return np.zeros(K, np.float64)
+        pm = self._packed_model(start_iteration, end)
+        out = pm.predict_single(np.asarray(x, np.float64))
+        if self.average_output:
+            out /= (end - start_iteration)
+        return out
+
     def predict(self, X: np.ndarray, raw_score: bool = False,
-                start_iteration: int = 0, num_iteration: int = -1
-                ) -> np.ndarray:
-        raw = self.predict_raw(X, start_iteration, num_iteration)
+                start_iteration: int = 0, num_iteration: int = -1,
+                **pred_kwargs) -> np.ndarray:
+        raw = self.predict_raw(X, start_iteration, num_iteration,
+                               **pred_kwargs)
         if not raw_score and self.objective is not None \
                 and self.objective.need_convert_output:
             raw = self.objective.convert_output(raw)

@@ -1,0 +1,255 @@
+"""Packed multi-tree predictor: batch, single-row fast path, early stop.
+
+The reference predicts by walking trees one at a time per row
+(GBDT::PredictRaw, gbdt_prediction.cpp; Tree::Predict, tree.h:438) with
+optional margin-based early stopping (prediction_early_stop.cpp) and a
+single-row fast path that pre-resolves per-call state
+(LGBM_BoosterPredictForMatSingleRowFastInit, c_api.h:1399-1428).
+
+TPU-native re-design: all trees' node arrays are concatenated into flat
+"packed" arrays once (the FastInit analog), then every (row, tree) pair
+walks in lockstep — one vectorized step per tree level instead of a
+Python loop per tree. The same packed arrays drive:
+
+  * predict_margin:       [N, T]-lockstep chunked batch prediction
+  * predict_single:       [T]-lockstep one-row fast path (~depth steps)
+  * early stopping:       trees consumed in `freq`-sized groups; rows
+                          whose margin clears the bound drop out of later
+                          groups (binary: |margin|, multiclass: top-2 gap
+                          — prediction_early_stop.cpp:14-58)
+  * predict_margin_device: the same lockstep walk under jit for
+                          device-resident scoring of raw features
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .tree import (Tree, MISSING_NAN, MISSING_ZERO, _CATEGORICAL_MASK,
+                   _DEFAULT_LEFT_MASK, _KZERO_THRESHOLD)
+
+
+class PackedModel:
+    """Flat concatenation of a [start_it, end_it) slice of the model's
+    trees, iteration-major (tree t = iteration t // K, class t % K)."""
+
+    def __init__(self, trees: List[Tree], num_class_models: int):
+        self.K = num_class_models
+        self.T = len(trees)
+        node_counts = [max(t.num_leaves - 1, 1) for t in trees]
+        leaf_counts = [t.num_leaves for t in trees]
+        self.node_start = np.zeros(self.T + 1, np.int64)
+        np.cumsum(node_counts, out=self.node_start[1:])
+        self.leaf_start = np.zeros(self.T + 1, np.int64)
+        np.cumsum(leaf_counts, out=self.leaf_start[1:])
+        M = int(self.node_start[-1])
+        L = int(self.leaf_start[-1])
+        self.split_feature = np.zeros(M, np.int32)
+        self.threshold = np.zeros(M, np.float64)
+        self.threshold_in_bin = np.zeros(M, np.int32)
+        self.decision_type = np.zeros(M, np.int8)
+        self.left_child = np.zeros(M, np.int32)
+        self.right_child = np.zeros(M, np.int32)
+        self.leaf_value = np.zeros(L, np.float64)
+        # categorical bitsets, concatenated with per-tree offsets
+        self.num_cat = sum(t.num_cat for t in trees)
+        cb = [np.zeros(0, np.int32)]
+        ct = [np.zeros(0, np.uint32)]
+        self.cat_start = np.zeros(self.T, np.int32)      # into boundaries
+        self.word_start = np.zeros(self.T, np.int32)     # into bitset words
+        cat_off = word_off = 0
+        self.single_leaf = np.array(
+            [t.num_leaves <= 1 for t in trees], bool)
+        for i, t in enumerate(trees):
+            a, b = self.node_start[i], self.node_start[i + 1]
+            m = t.num_leaves - 1
+            if m > 0:
+                self.split_feature[a:a + m] = t.split_feature
+                self.threshold[a:a + m] = t.threshold
+                self.threshold_in_bin[a:a + m] = t.threshold_in_bin
+                self.decision_type[a:a + m] = t.decision_type
+                self.left_child[a:a + m] = t.left_child
+                self.right_child[a:a + m] = t.right_child
+            la = self.leaf_start[i]
+            self.leaf_value[la:la + t.num_leaves] = t.leaf_value
+            self.cat_start[i] = cat_off
+            self.word_start[i] = word_off
+            if t.num_cat > 0:
+                cb.append(np.asarray(t.cat_boundaries, np.int32))
+                ct.append(np.asarray(t.cat_threshold, np.uint32))
+                cat_off += t.num_cat + 1
+                word_off += len(t.cat_threshold)
+        self.cat_boundaries = np.concatenate(cb)
+        self.cat_threshold = np.concatenate(ct)
+
+    # ------------------------------------------------------------------
+    def _step(self, X, rows, node, tsel):
+        """One lockstep level: X [n, F]; rows [n] row ids; node [n, S]
+        LOCAL node ids (>=0 active, <0 leaf); tsel [S] tree indices.
+        Returns next node matrix."""
+        active = node >= 0
+        gnode = np.maximum(node, 0) + self.node_start[tsel][None, :]
+        f = self.split_feature[gnode]
+        fval = X[rows[:, None], f].astype(np.float64)
+        dt = self.decision_type[gnode]
+        default_left = (dt & _DEFAULT_LEFT_MASK) != 0
+        missing_type = (dt.astype(np.int32) >> 2) & 3
+        nan_mask = np.isnan(fval)
+        fval_n = np.where(nan_mask & (missing_type != MISSING_NAN), 0.0,
+                          fval)
+        is_missing = ((missing_type == MISSING_ZERO)
+                      & (np.abs(fval_n) <= _KZERO_THRESHOLD)) | \
+                     ((missing_type == MISSING_NAN) & nan_mask)
+        go_left = np.where(is_missing, default_left,
+                           fval_n <= self.threshold[gnode])
+        if self.num_cat > 0:
+            is_cat = (dt & _CATEGORICAL_MASK) != 0
+            if is_cat.any():
+                go_left = np.where(is_cat,
+                                   self._cat_go_left(fval, gnode, tsel),
+                                   go_left)
+        nxt = np.where(go_left, self.left_child[gnode],
+                       self.right_child[gnode])
+        return np.where(active, nxt, node)
+
+    def _cat_go_left(self, fval, gnode, tsel):
+        valid = ~np.isnan(fval) & (fval >= 0)
+        iv = np.where(valid, fval, 0).astype(np.int64)
+        cat_idx = self.threshold_in_bin[gnode].astype(np.int64)
+        cb_idx = np.clip(self.cat_start[tsel][None, :] + cat_idx, 0,
+                         max(len(self.cat_boundaries) - 2, 0))
+        starts = self.word_start[tsel][None, :] + self.cat_boundaries[cb_idx]
+        sizes = self.cat_boundaries[cb_idx + 1] - self.cat_boundaries[cb_idx]
+        in_range = valid & (iv < sizes.astype(np.int64) * 32)
+        word = starts + np.minimum(iv // 32, np.maximum(sizes - 1, 0))
+        bits = self.cat_threshold[np.clip(word, 0,
+                                          len(self.cat_threshold) - 1)]
+        return in_range & (((bits >> (iv % 32).astype(np.uint32)) & 1) == 1)
+
+    def _leaves(self, X, rows, tsel):
+        """Leaf VALUE matrix [n, S] for the selected trees."""
+        n = rows.shape[0]
+        S = tsel.shape[0]
+        node = np.where(self.single_leaf[tsel][None, :],
+                        -1, 0).astype(np.int32) * np.ones((n, 1), np.int32)
+        for _ in range(64 * 1024):
+            if not (node >= 0).any():
+                break
+            node = self._step(X, rows, node, tsel)
+        leaf = ~node
+        return self.leaf_value[self.leaf_start[tsel][None, :] + leaf]
+
+    # ------------------------------------------------------------------
+    def predict_margin(
+        self,
+        X: np.ndarray,                      # [N, F] raw features
+        early_stop_margin: Optional[float] = None,
+        early_stop_freq: int = 10,
+        chunk: int = 8192,
+    ) -> np.ndarray:
+        """[K, N] f64 margins. With `early_stop_margin`, trees are
+        consumed in freq-iteration groups and rows whose margin clears
+        the bound stop evaluating further trees
+        (prediction_early_stop.cpp: binary |margin| > m at :30,
+        multiclass top1-top2 > m at :14)."""
+        N = X.shape[0]
+        K = self.K
+        n_iters = self.T // K
+        out = np.zeros((K, N), np.float64)
+        for c0 in range(0, N, chunk):
+            rows = np.arange(c0, min(c0 + chunk, N))
+            if early_stop_margin is None:
+                tsel = np.arange(self.T)
+                lv = self._leaves(X, rows, tsel)          # [n, T]
+                out[:, rows] = lv.reshape(len(rows), n_iters, K) \
+                    .sum(axis=1).T
+            else:
+                alive = rows
+                acc = np.zeros((K, len(rows)), np.float64)
+                for g0 in range(0, n_iters, early_stop_freq):
+                    g1 = min(g0 + early_stop_freq, n_iters)
+                    tsel = np.arange(g0 * K, g1 * K)
+                    lv = self._leaves(X, alive, tsel)
+                    local = np.searchsorted(rows, alive)
+                    acc[:, local] += lv.reshape(len(alive), g1 - g0, K) \
+                        .sum(axis=1).T
+                    if g1 >= n_iters:
+                        break
+                    m = acc[:, local]
+                    if K == 1:
+                        go_on = np.abs(m[0]) < early_stop_margin
+                    else:
+                        s = np.sort(m, axis=0)
+                        go_on = (s[-1] - s[-2]) < early_stop_margin
+                    alive = alive[go_on]
+                    if alive.size == 0:
+                        break
+                out[:, rows] = acc
+        return out
+
+    # ------------------------------------------------------------------
+    def predict_single(self, x: np.ndarray) -> np.ndarray:
+        """[K] margins for ONE row — all trees walk in lockstep, ~depth
+        vectorized [T]-sized steps (the FastConfig single-row analog:
+        the packed arrays are the pre-resolved state)."""
+        X = x.reshape(1, -1)
+        rows = np.zeros(1, np.int64)
+        lv = self._leaves(X, rows, np.arange(self.T))[0]  # [T]
+        return lv.reshape(self.T // self.K, self.K).sum(axis=0)
+
+
+def predict_margin_device(packed: PackedModel, X) -> "object":
+    """Device-side batch margins over raw features: the same lockstep
+    walk under jit (CUDA analog: gbdt_prediction with CUDATree,
+    cuda_tree.hpp:29). X is [N, F] float32 on device; returns [K, N]
+    f32 margins. Numeric splits only — categorical models must use the
+    host paths (predict_margin / predict_single)."""
+    if packed.num_cat > 0:
+        raise ValueError("predict_margin_device does not support "
+                         "categorical splits; use predict_margin")
+    import jax
+    import jax.numpy as jnp
+
+    sf = jnp.asarray(packed.split_feature)
+    thr = jnp.asarray(packed.threshold.astype(np.float32))
+    dt = jnp.asarray(packed.decision_type.astype(np.int32))
+    lc = jnp.asarray(packed.left_child)
+    rc = jnp.asarray(packed.right_child)
+    lval = jnp.asarray(packed.leaf_value.astype(np.float32))
+    nstart = jnp.asarray(packed.node_start[:-1].astype(np.int32))
+    lstart = jnp.asarray(packed.leaf_start[:-1].astype(np.int32))
+    single = jnp.asarray(packed.single_leaf)
+    T, K = packed.T, packed.K
+
+    @jax.jit
+    def run(X):
+        N = X.shape[0]
+        node0 = jnp.where(single[None, :], -1, 0) * jnp.ones(
+            (N, 1), jnp.int32)
+
+        def cond(node):
+            return jnp.any(node >= 0)
+
+        def body(node):
+            gnode = jnp.maximum(node, 0) + nstart[None, :]
+            f = sf[gnode]
+            fval = jnp.take_along_axis(X, f, axis=1)
+            mt = (dt[gnode] >> 2) & 3
+            nan_mask = jnp.isnan(fval)
+            fval_n = jnp.where(nan_mask & (mt != MISSING_NAN), 0.0, fval)
+            is_missing = ((mt == MISSING_ZERO)
+                          & (jnp.abs(fval_n) <= _KZERO_THRESHOLD)) | \
+                         ((mt == MISSING_NAN) & nan_mask)
+            default_left = (dt[gnode] & _DEFAULT_LEFT_MASK) != 0
+            go_left = jnp.where(is_missing, default_left,
+                                fval_n <= thr[gnode])
+            nxt = jnp.where(go_left, lc[gnode], rc[gnode])
+            return jnp.where(node >= 0, nxt, node)
+
+        node = jax.lax.while_loop(cond, body, node0)
+        lv = lval[lstart[None, :] + (~node)]              # [N, T]
+        return lv.reshape(N, T // K, K).sum(axis=1).T     # [K, N]
+
+    return run(X)

@@ -1,0 +1,127 @@
+"""Packed predictor: batch/single-row/early-stop/device parity with the
+per-tree host walk (reference semantics: gbdt_prediction.cpp,
+prediction_early_stop.cpp, c_api.h:1399 single-row fast path)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _per_tree_margin(g, X):
+    K = g.num_tree_per_iteration
+    out = np.zeros((K, X.shape[0]), np.float64)
+    for i, t in enumerate(g.models):
+        out[i % K] += t.predict(X)
+    return out
+
+
+@pytest.fixture(scope="module")
+def binary_model(rng_mod):
+    rng = rng_mod
+    X = rng.normal(size=(4000, 10)).astype(np.float32)
+    w = rng.normal(size=10)
+    y = (X @ w + rng.normal(scale=0.3, size=4000) > 0).astype(np.float32)
+    X[::11, 3] = np.nan
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1}, ds, num_boost_round=12)
+    return bst, X
+
+
+@pytest.fixture(scope="module")
+def rng_mod():
+    return np.random.RandomState(17)
+
+
+def test_packed_matches_per_tree(binary_model):
+    bst, X = binary_model
+    g = bst._gbdt
+    ref = _per_tree_margin(g, X[:500])
+    got = g.predict_raw(X[:500])
+    np.testing.assert_allclose(got, ref, rtol=1e-12)
+
+
+def test_single_row_fast_path(binary_model):
+    bst, X = binary_model
+    g = bst._gbdt
+    for r in (0, 3, 11):
+        ref = _per_tree_margin(g, X[r:r + 1])[:, 0]
+        got = g.predict_single_row(X[r])
+        np.testing.assert_allclose(got, ref, rtol=1e-12)
+
+
+def test_early_stop_margin_huge_is_exact(binary_model):
+    bst, X = binary_model
+    g = bst._gbdt
+    full = g.predict_raw(X[:400])
+    es = g.predict_raw(X[:400], pred_early_stop=True,
+                       pred_early_stop_freq=4,
+                       pred_early_stop_margin=1e30)
+    np.testing.assert_allclose(es, full, rtol=1e-12)
+
+
+def test_early_stop_small_margin_keeps_confident_sign(binary_model):
+    bst, X = binary_model
+    g = bst._gbdt
+    full = g.predict_raw(X[:1000])[0]
+    es = g.predict_raw(X[:1000], pred_early_stop=True,
+                       pred_early_stop_freq=2,
+                       pred_early_stop_margin=0.5)[0]
+    # rows stopped early halted with a margin beyond the bound (the
+    # approximation the reference makes, prediction_early_stop.cpp:30);
+    # rows never stopped are exact (up to f64 summation-order ulps)
+    stopped = np.abs(es - full) > 1e-9
+    assert stopped.any()
+    assert np.all(np.abs(es[stopped]) >= 0.5)
+    # and predict() plumbs the params through
+    p_es = bst.predict(X[:1000], raw_score=True, pred_early_stop=True,
+                       pred_early_stop_freq=2, pred_early_stop_margin=0.5)
+    np.testing.assert_allclose(p_es, es, rtol=1e-12)
+
+
+def test_multiclass_early_stop_and_single(rng_mod):
+    rng = rng_mod
+    X = rng.normal(size=(3000, 8)).astype(np.float32)
+    y = (X[:, 0] * 2 + X[:, 1] > 0).astype(int) + \
+        2 * (X[:, 2] > 0.5).astype(int)
+    ds = lgb.Dataset(X, label=y.astype(np.float32))
+    bst = lgb.train({"objective": "multiclass", "num_class": 4,
+                     "num_leaves": 7, "verbose": -1}, ds,
+                    num_boost_round=6)
+    g = bst._gbdt
+    ref = _per_tree_margin(g, X[:200])
+    np.testing.assert_allclose(g.predict_raw(X[:200]), ref, rtol=1e-12)
+    np.testing.assert_allclose(g.predict_single_row(X[5]), ref[:, 5],
+                               rtol=1e-12)
+    es = g.predict_raw(X[:200], pred_early_stop=True,
+                       pred_early_stop_freq=2,
+                       pred_early_stop_margin=1e30)
+    np.testing.assert_allclose(es, ref, rtol=1e-12)
+
+
+def test_categorical_packed_parity(rng_mod):
+    rng = rng_mod
+    N = 3000
+    Xc = rng.randint(0, 12, size=(N, 1)).astype(np.float32)
+    Xn = rng.normal(size=(N, 3)).astype(np.float32)
+    X = np.concatenate([Xc, Xn], axis=1)
+    y = ((Xc[:, 0] % 3 == 0) ^ (Xn[:, 0] > 0)).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1, "min_data_in_leaf": 5}, ds,
+                    num_boost_round=8)
+    g = bst._gbdt
+    ref = _per_tree_margin(g, X[:300])
+    np.testing.assert_allclose(g.predict_raw(X[:300]), ref, rtol=1e-12)
+
+
+def test_device_predictor_parity(binary_model):
+    import jax.numpy as jnp
+    from lightgbm_tpu.models.predictor import predict_margin_device
+    bst, X = binary_model
+    g = bst._gbdt
+    pm = g._packed_model(0, len(g.models))
+    ref = _per_tree_margin(g, X[:256])
+    got = np.asarray(predict_margin_device(pm, jnp.asarray(X[:256])))
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-6)
