@@ -133,6 +133,22 @@ class Dataset:
                 magic = f.read(4)
             if magic[:2] == b"PK":
                 self._handle = load_binary_file(path, cfg)
+                if self.reference is not None:
+                    # the binary cache carries its own mappers; a
+                    # reference can only be honored if they are identical
+                    # (Dataset::CheckAlign semantics — raw data is gone,
+                    # so re-binning against the reference is impossible)
+                    self.reference.construct()
+                    rh = self.reference._handle
+                    ours = [m.to_dict() for m in self._handle.mappers]
+                    refs = [m.to_dict() for m in rh.mappers]
+                    if ours != refs:
+                        log_fatal(
+                            f"binary dataset {path} was saved with bin "
+                            "mappers that differ from the reference "
+                            "dataset's; rebuild the cache from a Dataset "
+                            "constructed with reference=...")
+                    self._handle.reference = rh
                 for setter, val in ((self._handle.metadata.set_label,
                                      self.label),
                                     (self._handle.metadata.set_weight,

@@ -74,6 +74,7 @@ def find_best_split_categorical(
     feature_mask: jnp.ndarray | None = None,
     leaf_min: jnp.ndarray | None = None,
     leaf_max: jnp.ndarray | None = None,
+    cegb_pen: jnp.ndarray | None = None,      # [F] f32 CEGB gain penalty
 ) -> tuple[SplitResult, jnp.ndarray]:
     """Best categorical split over all features for one leaf.
 
@@ -154,6 +155,9 @@ def find_best_split_categorical(
 
     stats1 = (lg1, lh1, lc1, rg1, rh1, rc1, lout1, rout1)
     all_gain = jnp.stack([gain1, gain_a, gain_d])            # [3, F, B]
+    if cegb_pen is not None:
+        all_gain = jnp.where(jnp.isfinite(all_gain),
+                             all_gain - cegb_pen[None, :, None], all_gain)
     all_stats = [jnp.stack([a, b, d])
                  for a, b, d in zip(stats1, stats_a, stats_d)]
 

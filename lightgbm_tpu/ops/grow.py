@@ -93,6 +93,12 @@ class GrowConfig(NamedTuple):
     # in the wave grower (data_parallel_tree_learner.cpp:72-122)
     n_shards: int = 1
 
+    # CEGB (cost-effective gradient boosting,
+    # cost_effective_gradient_boosting.hpp:81 DeltaGain): gain penalty
+    # tradeoff * (penalty_split * leaf_count + coupled[f] * first-use)
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
+
     @property
     def bundled(self) -> bool:
         return len(self.bundle_col) > 0

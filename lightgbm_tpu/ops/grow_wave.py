@@ -149,6 +149,13 @@ class _WaveState(NamedTuple):
     catr: jnp.ndarray              # [L] bool
     bitsl: jnp.ndarray             # [L, W] u32
     bitsr: jnp.ndarray             # [L, W] u32
+    leaf_forced: jnp.ndarray       # [L] i32 forced-node id (-1 = none)
+    best_forced: jnp.ndarray       # [L] bool: best split IS the forced one
+    feat_used: jnp.ndarray         # [F] bool: CEGB coupled-penalty state
+    fidl: jnp.ndarray              # [L] i32 left child's forced-node id
+    fidr: jnp.ndarray              # [L] i32 right child's forced-node id
+    bfl: jnp.ndarray               # [L] bool: left child's best is forced
+    bfr: jnp.ndarray               # [L] bool: right child's best is forced
 
 
 class _SimState(NamedTuple):
@@ -173,6 +180,8 @@ def grow_tree_wave(
     feature_mask: Optional[jnp.ndarray] = None,
     dist: Optional[object] = None,
     rng_seed: Optional[jnp.ndarray] = None,
+    cegb_used: Optional[jnp.ndarray] = None,   # [F] bool: features already
+    #   used by ANY split of the model (coupled-penalty state)
 ) -> tuple[DeviceTree, jnp.ndarray]:
     """Wave-pipelined exact leaf-wise growth; contract of grow.py:grow_tree."""
     # with EFB, X_t holds BUNDLE columns; F is the ORIGINAL feature count
@@ -257,7 +266,22 @@ def grow_tree_wave(
 
     has_mono = meta.monotone is not None
     has_inter = meta.inter_sets is not None
+    has_forced = meta.forced is not None
+    has_cegb = (cfg.cegb_penalty_split > 0.0
+                or meta.cegb_coupled is not None)
+    if has_cegb and cegb_used is None:
+        cegb_used = jnp.zeros((F,), bool)
     S = meta.inter_sets.shape[0] if has_inter else 1
+
+    def sel_key(gain, is_forced, fid):
+        """Wave selection/priority key: forced splits outrank everything
+        and apply in BFS order (ForceSplits walks its queue before normal
+        growth, serial_tree_learner.cpp:628); the stored split gain stays
+        the real one."""
+        if not has_forced:
+            return gain
+        return jnp.where(is_forced, 3e18 - fid.astype(jnp.float32) * 1e12,
+                         gain)
 
     # ---- reduce-scatter feature ownership (tree_learner=data comm
     # scaling, data_parallel_tree_learner.cpp:72-122 PrepareBufferPos +
@@ -292,6 +316,7 @@ def grow_tree_wave(
             monotone=_slice_f(meta.monotone, 0),
             inter_sets=(_slice_f(meta.inter_sets, 1)
                         if has_inter else None),
+            cegb_coupled=_slice_f(meta.cegb_coupled, 0),
         )
         fmask_sh = (_slice_f(feature_mask, 0)
                     if feature_mask is not None else None)
@@ -305,8 +330,9 @@ def grow_tree_wave(
         m = jnp.any(meta_u.inter_sets & sets_row[:, None], axis=0)
         return m if fmask_u is None else m & fmask_u
 
-    def make_search(meta_use, fmask_use):
-      def search(hist2, sum_g, sum_h, count, out, bmin, bmax, sets_row):
+    def make_search(meta_use, fmask_use, foffset=0):
+      def search(hist2, sum_g, sum_h, count, out, bmin, bmax, sets_row,
+                 forced_id=None, used_f=None):
         if cfg.bundled:
             # EFB: re-slice the bundle histogram per ORIGINAL feature
             # (Dataset::ConstructHistograms offsets) and reconstruct each
@@ -325,25 +351,66 @@ def grow_tree_wave(
         hist = jnp.concatenate([hist2, hist2[1:2] * cntf], axis=0)
         fmask = (sets_to_fmask(sets_row, meta_use, fmask_use)
                  if has_inter else fmask_use)
+        pen = None
+        if has_cegb and used_f is not None:
+            # DeltaGain (cost_effective_gradient_boosting.hpp:81):
+            # tradeoff * (penalty_split * leaf_count + coupled on first
+            # feature use)
+            F_use = int(meta_use.num_bins.shape[0])
+            u = used_f
+            if u.shape[0] != F_use:       # sharded search: own slice
+                u = jax.lax.dynamic_slice_in_dim(
+                    jnp.pad(u, (0, F_use * nsh - u.shape[0])),
+                    foffset, F_use, 0)
+            pen = jnp.full((F_use,),
+                           cfg.cegb_tradeoff * cfg.cegb_penalty_split
+                           * count, jnp.float32)
+            if meta_use.cegb_coupled is not None:
+                pen = pen + cfg.cegb_tradeoff * meta_use.cegb_coupled \
+                    * (1.0 - u.astype(jnp.float32))
         num = find_best_split(hist, sum_g, sum_h, count, out, meta_use, hp,
                               fmask,
                               leaf_min=bmin if has_mono else None,
-                              leaf_max=bmax if has_mono else None)
+                              leaf_max=bmax if has_mono else None,
+                              cegb_pen=pen)
+        nob = jnp.zeros((W,), jnp.uint32)
         if not cfg.has_categorical:
-            return num, jnp.zeros((), bool), jnp.zeros((W,), jnp.uint32)
-        catres, bitset = find_best_split_categorical(
-            hist, sum_g, sum_h, count, out, meta_use, hp, cfg.cat, fmask,
+            merged, use_cat, bits = num, jnp.zeros((), bool), nob
+        else:
+            catres, bitset = find_best_split_categorical(
+                hist, sum_g, sum_h, count, out, meta_use, hp, cfg.cat,
+                fmask,
+                leaf_min=bmin if has_mono else None,
+                leaf_max=bmax if has_mono else None,
+                cegb_pen=pen)
+            use_cat = catres.gain > num.gain
+            merged = SplitResult(*[
+                jnp.where(use_cat, cv, nv) for cv, nv in zip(catres, num)])
+            bits = jnp.where(use_cat, bitset, nob)
+        if not has_forced or forced_id is None:
+            return merged, use_cat, bits, jnp.zeros((), bool)
+        # forced-split override: fixed (feature, threshold) from the
+        # forced table; the column sampler does not apply to forced
+        # splits. In sharded search the forced feature may live on
+        # another shard (local id out of range -> -inf; the owner wins
+        # at merge time).
+        fid_c = jnp.clip(forced_id, 0, meta.forced.shape[1] - 1)
+        ff = meta.forced[0, fid_c] - foffset
+        fb = meta.forced[1, fid_c]
+        fres = find_best_split(
+            hist, sum_g, sum_h, count, out, meta_use, hp, None,
             leaf_min=bmin if has_mono else None,
-            leaf_max=bmax if has_mono else None)
-        use_cat = catres.gain > num.gain
+            leaf_max=bmax if has_mono else None,
+            forced_f=ff, forced_b=fb)
+        use_f = (forced_id >= 0) & jnp.isfinite(fres.gain)
         merged = SplitResult(*[
-            jnp.where(use_cat, cv, nv) for cv, nv in zip(catres, num)])
-        return merged, use_cat, jnp.where(use_cat, bitset,
-                                          jnp.zeros((W,), jnp.uint32))
+            jnp.where(use_f, fv, mv) for fv, mv in zip(fres, merged)])
+        return (merged, use_cat & ~use_f, jnp.where(use_f, nob, bits),
+                use_f)
       return search
 
     search = make_search(meta, feature_mask)
-    search_sh = make_search(meta_sh, fmask_sh) if fo else search
+    search_sh = make_search(meta_sh, fmask_sh, foff) if fo else search
 
     def child_sets(bs, psets):
         """Constraint sets still satisfiable in the children: the parent's
@@ -375,12 +442,15 @@ def grow_tree_wave(
         / (root_h + hp.lambda_l2), jnp.float32)
 
     hist_root = psum(build_histogram(X_t, vals0, B, cfg.rows_per_chunk))
-    root_split, root_is_cat, root_bitset = search(
+    root_fid = jnp.asarray(0 if has_forced else -1, jnp.int32)
+    used0 = (cegb_used if has_cegb else jnp.zeros((F,), bool))
+    root_split, root_is_cat, root_bitset, root_forced = search(
         hist_root, root_g, root_h, root_c, root_out,
         jnp.float32(-jnp.inf), jnp.float32(jnp.inf),
-        jnp.ones((S,), bool))
+        jnp.ones((S,), bool), forced_id=root_fid, used_f=used0)
     root_split = root_split._replace(
         gain=jnp.where(max_depth >= 1, root_split.gain, NEG_INF))
+    root_forced &= max_depth >= 1
     if fo:
         # the per-shard caches hold this shard's feature slice only
         pads = [(0, 0)] * hist_root.ndim
@@ -437,6 +507,13 @@ def grow_tree_wave(
         catl=jnp.zeros((L,), bool), catr=jnp.zeros((L,), bool),
         bitsl=jnp.zeros((L, W), jnp.uint32),
         bitsr=jnp.zeros((L, W), jnp.uint32),
+        leaf_forced=jnp.full((L,), -1, jnp.int32).at[0].set(root_fid),
+        best_forced=jnp.zeros((L,), bool).at[0].set(root_forced),
+        feat_used=used0,
+        fidl=jnp.full((L,), -1, jnp.int32),
+        fidr=jnp.full((L,), -1, jnp.int32),
+        bfl=jnp.zeros((L,), bool),
+        bfr=jnp.zeros((L,), bool),
     )
 
     def table_go_left(leaf_of_row, tbl_leaf, sp_feat, sp_thr, sp_dleft,
@@ -599,9 +676,13 @@ def grow_tree_wave(
         if cfg.wave_exact:
             # strict leaf-wise: serial simulation that blocks when the
             # priority-queue head has no speculated child data yet
-            sim_cond, sim_step = make_sim(st.bestl.gain, st.bestr.gain)
+            # (sel_key lets pending forced splits outrank normal ones)
+            sim_cond, sim_step = make_sim(
+                sel_key(st.bestl.gain, st.bfl, st.fidl),
+                sel_key(st.bestr.gain, st.bfr, st.fidr))
             sim = jax.lax.while_loop(sim_cond, sim_step, _SimState(
-                gain=st.best.gain, ready=st.ready,
+                gain=sel_key(st.best.gain, st.best_forced, st.leaf_forced),
+                ready=st.ready,
                 n_leaves=st.tree.num_leaves,
                 n_applied=jnp.asarray(0, jnp.int32),
                 app_leaf=jnp.full((KMAX,), -1, jnp.int32)))
@@ -615,12 +696,13 @@ def grow_tree_wave(
             # least the top half of the ready set always applies, so a
             # dominant-gain chain cannot degenerate to one split per wave
             # (O(L) waves observed without this).
-            ready_gain = jnp.where(st.ready, st.best.gain, NEG_INF)
+            keyed = sel_key(st.best.gain, st.best_forced, st.leaf_forced)
+            ready_gain = jnp.where(st.ready, keyed, NEG_INF)
             rg, rl = jax.lax.top_k(ready_gain, KMAX)
             sel = (rg > 0.0) & (j_iota < budget)
             if cfg.wave_gain_slack > 0.0:
                 npos = jnp.sum(sel).astype(jnp.int32)
-                guard = rg >= cfg.wave_gain_slack * jnp.max(st.best.gain)
+                guard = rg >= cfg.wave_gain_slack * jnp.max(keyed)
                 sel &= guard | (j_iota < (npos + 1) // 2)
             napp = jnp.sum(sel).astype(jnp.int32)
             app_leaf = jnp.where(sel, rl.astype(jnp.int32), -1)
@@ -713,6 +795,11 @@ def grow_tree_wave(
         leaf_max2 = upd2(st.leaf_max, almax, armax)
         asets = child_sets(bs2, st.leaf_sets[p_j])
         leaf_sets2 = upd2(st.leaf_sets, asets, asets)
+        leaf_forced2 = upd2(st.leaf_forced, st.fidl[p_j], st.fidr[p_j],
+                            jnp.int32)
+        best_forced2 = upd2(st.best_forced, st.bfl[p_j], st.bfr[p_j])
+        feat_used2 = st.feat_used.at[
+            jnp.where(appv, bs2.feature, F)].set(True, mode="drop")
 
         st = st._replace(
             tree=t,
@@ -730,12 +817,15 @@ def grow_tree_wave(
             leaf_min=leaf_min2, leaf_max=leaf_max2,
             leaf_sets=leaf_sets2,
             best=best, best_is_cat=best_is_cat, best_bitset=best_bitset,
+            leaf_forced=leaf_forced2, best_forced=best_forced2,
+            feat_used=feat_used2,
         )
 
         # ---- SPECULATE selection: top-K unready frontier leaves by gain
         # (post-apply state: fresh children compete immediately)
         budget2 = L - st.tree.num_leaves
-        cand_gain = jnp.where(st.ready, NEG_INF, st.best.gain)
+        keyed2 = sel_key(st.best.gain, st.best_forced, st.leaf_forced)
+        cand_gain = jnp.where(st.ready, NEG_INF, keyed2)
         gains, cand = jax.lax.top_k(cand_gain, KMAX)
         cand = cand.astype(jnp.int32)
         valid = (gains > 0.0) & (j_iota < budget2)
@@ -746,7 +836,7 @@ def grow_tree_wave(
             # count paid per tree near the number of splits actually made
             # (the apply-side guard is at wave_step's top).
             nval = jnp.sum(valid).astype(jnp.int32)
-            guard = gains >= cfg.wave_gain_slack * jnp.max(st.best.gain)
+            guard = gains >= cfg.wave_gain_slack * jnp.max(keyed2)
             valid &= guard | (j_iota < (nval + 1) // 2)
         n_cand = jnp.sum(valid).astype(jnp.int32)
         bs = SplitResult(*[x[cand] for x in st.best])
@@ -851,19 +941,37 @@ def grow_tree_wave(
             bmax_lr = jnp.concatenate([clmax, crmax])
             csets = child_sets(bs, st.leaf_sets[cand])       # [K, S]
             sets_lr = jnp.concatenate([csets, csets], axis=0)
-            s_lr, cat_lr, bits_lr = jax.vmap(search_sh)(
+            # children's forced-node ids: candidate's best IS its forced
+            # split -> its children continue the forced table (BFS walk)
+            if has_forced:
+                cfid = st.leaf_forced[cand]
+                cforced = st.best_forced[cand]
+                cfid_c = jnp.clip(cfid, 0, meta.forced.shape[1] - 1)
+                fidl_k = jnp.where(cforced, meta.forced[2, cfid_c], -1)
+                fidr_k = jnp.where(cforced, meta.forced[3, cfid_c], -1)
+                fid_lr = jnp.concatenate([fidl_k, fidr_k])
+            else:
+                fidl_k = fidr_k = jnp.full((KMAX,), -1, jnp.int32)
+                fid_lr = None
+            s_lr, cat_lr, bits_lr, forced_lr = jax.vmap(
+                lambda h_, sg_, sh_, c_, o_, bn_, bx_, st_, fi_:
+                search_sh(h_, sg_, sh_, c_, o_, bn_, bx_, st_, fi_,
+                          used_f=st.feat_used))(
                 hist_lr, sg_lr, sh_lr, c_lr, o_lr, bmin_lr, bmax_lr,
-                sets_lr)
+                sets_lr, fid_lr)
             if fo:
                 # map slice-local feature ids to global, then merge the
-                # per-shard bests by gain (SyncUpGlobalBestSplit,
-                # parallel_tree_learner.h:210-233)
+                # per-shard bests by SELECTION KEY (a forced split must
+                # beat other shards' normal bests regardless of gain;
+                # SyncUpGlobalBestSplit, parallel_tree_learner.h:210-233)
                 s_lr = s_lr._replace(feature=s_lr.feature + foff)
-                rec = (tuple(s_lr), cat_lr, bits_lr)
+                rec = (tuple(s_lr), cat_lr, bits_lr, forced_lr)
                 allr = jax.tree.map(
                     lambda a: dist.all_gather(a, axis=0, tiled=False), rec)
-                gains_all = allr[0][0]                    # [n, 2K]
-                pick = jnp.argmax(gains_all, axis=0)      # [2K]
+                key_all = allr[0][0]                      # [n, 2K] gains
+                if has_forced:
+                    key_all = jnp.where(allr[3], 2e18, key_all)
+                pick = jnp.argmax(key_all, axis=0)        # [2K]
 
                 def take(a):
                     idx = pick.reshape((1,) + pick.shape
@@ -875,12 +983,14 @@ def grow_tree_wave(
                 s_lr = SplitResult(*[take(a) for a in allr[0]])
                 cat_lr = take(allr[1])
                 bits_lr = take(allr[2])
+                forced_lr = take(allr[3])
             # depth mask applied at store time so the order simulation can
             # use stored gains directly
             can = st.leaf_depth[cand] + 1 < max_depth
+            can2 = jnp.concatenate([can, can])
             s_lr = s_lr._replace(
-                gain=jnp.where(jnp.concatenate([can, can]), s_lr.gain,
-                               NEG_INF))
+                gain=jnp.where(can2, s_lr.gain, NEG_INF))
+            forced_lr = forced_lr & can2
 
             def scat(arr, v, expand=False):
                 vv = jnp.where(valid[:, None] if expand else valid, v,
@@ -900,6 +1010,10 @@ def grow_tree_wave(
                 catr=scat(st.catr, cat_lr[KMAX:]),
                 bitsl=scat(st.bitsl, bits_lr[:KMAX], expand=True),
                 bitsr=scat(st.bitsr, bits_lr[KMAX:], expand=True),
+                fidl=scat(st.fidl, fidl_k),
+                fidr=scat(st.fidr, fidr_k),
+                bfl=scat(st.bfl, forced_lr[:KMAX]),
+                bfr=scat(st.bfr, forced_lr[KMAX:]),
             )
 
         st = st._replace(tree=st.tree._replace(
@@ -907,7 +1021,8 @@ def grow_tree_wave(
         return jax.lax.cond(n_cand > 0, spec_branch, lambda s: s, st)
 
     def cond(st: _WaveState):
-        return (st.tree.num_leaves < L) & (jnp.max(st.best.gain) > 0.0)
+        keyed = sel_key(st.best.gain, st.best_forced, st.leaf_forced)
+        return (st.tree.num_leaves < L) & (jnp.max(keyed) > 0.0)
 
     if L > 1:
         state = jax.lax.while_loop(cond, wave_step, state)

@@ -67,6 +67,13 @@ class FeatureMeta(NamedTuple):
     #   histogram -> per-feature histogram gather map (OOB = fill 0)
     bundle_mfb: Optional[jnp.ndarray] = None     # [F, B] f32 one-hot of
     #   each feature's default bin (FixHistogram reconstruction)
+    forced: Optional[jnp.ndarray] = None  # [4, S] i32 forced-split tree in
+    #   BFS order: rows (feature, bin_threshold, left_child, right_child);
+    #   children are forced-node ids or -1 (forcedsplits_filename,
+    #   serial_tree_learner.cpp:628)
+    cegb_coupled: Optional[jnp.ndarray] = None  # [F] f32 per-feature
+    #   coupled penalty (cegb_penalty_feature_coupled mapped to inner
+    #   features; cost_effective_gradient_boosting.hpp:87)
 
 
 class SplitResult(NamedTuple):
@@ -127,6 +134,9 @@ def find_best_split(
     feature_mask: jnp.ndarray | None = None,  # [F] bool (col sampling)
     leaf_min: jnp.ndarray | None = None,      # scalar: monotone lower bound
     leaf_max: jnp.ndarray | None = None,      # scalar: monotone upper bound
+    forced_f: jnp.ndarray | None = None,      # scalar i32: forced feature
+    forced_b: jnp.ndarray | None = None,      # scalar i32: forced threshold
+    cegb_pen: jnp.ndarray | None = None,      # [F] f32: CEGB gain penalty
 ) -> SplitResult:
     """Best numerical split over all features for one leaf.
 
@@ -211,7 +221,24 @@ def find_best_split(
                            parent_count, parent_output)
     min_gain_shift = gain_shift + hp.min_gain_to_split
 
-    gain = jnp.where(ok & (gain > min_gain_shift), gain, NEG_INF)
+    if forced_f is not None:
+        # forced-split mode (SerialTreeLearner::ForceSplits,
+        # serial_tree_learner.cpp:628): the (feature, threshold) pair is
+        # fixed — only the missing direction is chosen — and the
+        # min-gain bar does not apply (a forced split lands even with
+        # negative gain; only the data/hessian constraints hold)
+        restrict = ((jnp.arange(F, dtype=jnp.int32) == forced_f)[:, None]
+                    & (bins == forced_b))
+        gain = jnp.where(ok & restrict[None, :, :], gain, NEG_INF)
+    else:
+        gain = jnp.where(ok & (gain > min_gain_shift), gain, NEG_INF)
+    if cegb_pen is not None:
+        # CEGB: per-feature gain penalty subtracted AFTER each feature's
+        # best-threshold scan, before the cross-feature argmax — the
+        # penalized gain is the stored one (DeltaGain applied at
+        # serial_tree_learner.cpp FindBestSplitsFromHistograms)
+        gain = jnp.where(jnp.isfinite(gain),
+                         gain - cegb_pen[None, :, None], gain)
 
     flat = gain.reshape(-1)
     best = jnp.argmax(flat)

@@ -1,0 +1,77 @@
+"""Cost-effective gradient boosting penalties (reference:
+cost_effective_gradient_boosting.hpp DeltaGain:81)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def xy():
+    rng = np.random.RandomState(21)
+    X = rng.normal(size=(4000, 6)).astype(np.float32)
+    w = np.array([2.0, 1.5, 1.0, 0.5, 0.25, 0.1])
+    y = (X @ w + rng.normal(scale=0.3, size=4000) > 0).astype(np.float32)
+    return X, y
+
+
+def _feat_counts(bst):
+    cnt = np.zeros(6, int)
+    for t in bst._gbdt.models:
+        for f in t.split_feature[:t.num_leaves - 1]:
+            cnt[f] += 1
+    return cnt
+
+
+def test_coupled_penalty_shrinks_feature_set(xy):
+    X, y = xy
+    base = dict(objective="binary", num_leaves=31, verbose=-1,
+                min_data_in_leaf=5)
+    b0 = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=8)
+    # huge coupled penalty on the weak features: they should disappear
+    pen = [0.0, 0.0, 0.0, 1e6, 1e6, 1e6]
+    b1 = lgb.train({**base, "cegb_tradeoff": 1.0,
+                    "cegb_penalty_feature_coupled": pen},
+                   lgb.Dataset(X, label=y), num_boost_round=8)
+    c0, c1 = _feat_counts(b0), _feat_counts(b1)
+    assert c0[3:].sum() > 0            # baseline uses the weak features
+    assert c1[3:].sum() == 0           # CEGB priced them out
+    assert c1[:3].sum() > 0
+
+
+def test_split_penalty_prunes_small_leaves(xy):
+    X, y = xy
+    base = dict(objective="binary", num_leaves=63, verbose=-1,
+                min_data_in_leaf=5)
+    b0 = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=4)
+    b1 = lgb.train({**base, "cegb_penalty_split": 0.1},
+                   lgb.Dataset(X, label=y), num_boost_round=4)
+    n0 = sum(t.num_leaves for t in b0._gbdt.models)
+    n1 = sum(t.num_leaves for t in b1._gbdt.models)
+    assert n1 < n0                     # splits got more expensive
+
+
+def test_coupled_penalty_charged_once(xy):
+    """A moderate coupled penalty is paid on first use only: once a
+    feature is in the model, later trees use it freely — quality stays
+    near the unpenalized baseline."""
+    X, y = xy
+    from sklearn.metrics import roc_auc_score
+    base = dict(objective="binary", num_leaves=31, verbose=-1,
+                min_data_in_leaf=5, learning_rate=0.2)
+    b0 = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=15)
+    b1 = lgb.train({**base, "cegb_penalty_feature_coupled": [5.0] * 6},
+                   lgb.Dataset(X, label=y), num_boost_round=15)
+    auc0 = roc_auc_score(y, b0.predict(X))
+    auc1 = roc_auc_score(y, b1.predict(X))
+    assert auc1 > auc0 - 0.02
+
+
+def test_lazy_penalty_rejected(xy):
+    X, y = xy
+    from lightgbm_tpu.utils.log import FatalError
+    with pytest.raises(FatalError):
+        lgb.train({"objective": "binary", "verbose": -1,
+                   "cegb_penalty_feature_lazy": [1.0] * 6},
+                  lgb.Dataset(X, label=y), num_boost_round=2)

@@ -89,9 +89,10 @@ def test_interaction_constraints(data):
 
 def test_unimplemented_params_fail_loudly(data):
     X, y = data
+    # forced splits and cegb split/coupled penalties are implemented now;
+    # what remains unimplemented must still fail loudly, never silently
     for bad in (dict(linear_tree=True),
-                dict(forcedsplits_filename="f.json"),
-                dict(cegb_penalty_split=0.1)):
+                dict(cegb_penalty_feature_lazy=[1.0] * X.shape[1])):
         with pytest.raises(FatalError):
             lgb.train(dict(objective="regression", verbose=-1, **bad),
                       lgb.Dataset(X, label=y), num_boost_round=1)

@@ -1,0 +1,87 @@
+"""Forced splits via forcedsplits_filename (reference:
+SerialTreeLearner::ForceSplits, serial_tree_learner.cpp:628)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def xy():
+    rng = np.random.RandomState(9)
+    X = rng.normal(size=(4000, 6)).astype(np.float32)
+    w = rng.normal(size=6)
+    y = (X @ w + rng.normal(scale=0.3, size=4000) > 0).astype(np.float32)
+    return X, y
+
+
+def _train(X, y, fs_path, rounds=3, **extra):
+    params = dict(objective="binary", num_leaves=15, verbose=-1,
+                  min_data_in_leaf=5, forcedsplits_filename=fs_path,
+                  **extra)
+    return lgb.train(params, lgb.Dataset(X, label=y),
+                     num_boost_round=rounds)
+
+
+def test_forced_root_and_child(tmp_path, xy):
+    X, y = xy
+    fs = {"feature": 3, "threshold": 0.25,
+          "right": {"feature": 1, "threshold": -0.5}}
+    p = str(tmp_path / "fs.json")
+    json.dump(fs, open(p, "w"))
+    bst = _train(X, y, p)
+    for t in bst._gbdt.models:
+        # node 0 is the first split = forced root
+        assert t.split_feature[0] == 3
+        assert t.threshold[0] == pytest.approx(0.25, abs=0.2)
+        # the root's right child must be the forced (1, -0.5) split:
+        # find the node whose parent is node 0 on the right
+        right = t.right_child[0]
+        assert right >= 0
+        assert t.split_feature[right] == 1
+        assert t.threshold[right] == pytest.approx(-0.5, abs=0.2)
+
+
+def test_forced_does_not_break_quality(tmp_path, xy):
+    X, y = xy
+    fs = {"feature": 0, "threshold": 0.0,
+          "left": {"feature": 1, "threshold": 0.0},
+          "right": {"feature": 1, "threshold": 0.0}}
+    p = str(tmp_path / "fs2.json")
+    json.dump(fs, open(p, "w"))
+    bst = _train(X, y, p, rounds=10)
+    from sklearn.metrics import roc_auc_score
+    auc = roc_auc_score(y, bst.predict(X))
+    assert auc > 0.85
+    # all 3 forced splits appear in every tree
+    for t in bst._gbdt.models[:3]:
+        assert t.split_feature[0] == 0
+        l, r = t.left_child[0], t.right_child[0]
+        assert t.split_feature[l] == 1 and t.split_feature[r] == 1
+
+
+def test_invalid_forced_falls_back(tmp_path, xy):
+    X, y = xy
+    # threshold far outside the data range -> one empty side -> invalid;
+    # normal growth must take over
+    fs = {"feature": 2, "threshold": 1e9}
+    p = str(tmp_path / "fs3.json")
+    json.dump(fs, open(p, "w"))
+    bst = _train(X, y, p, rounds=3)
+    t = bst._gbdt.models[0]
+    assert t.num_leaves > 1          # the tree still grew
+    # root is NOT the impossible forced split threshold
+    assert not (t.split_feature[0] == 2 and t.threshold[0] > 1e8)
+
+
+def test_wave_exact_forced(tmp_path, xy):
+    X, y = xy
+    fs = {"feature": 4, "threshold": 0.1}
+    p = str(tmp_path / "fs4.json")
+    json.dump(fs, open(p, "w"))
+    bst = _train(X, y, p, rounds=2, tpu_grower="wave_exact")
+    for t in bst._gbdt.models:
+        assert t.split_feature[0] == 4
