@@ -289,6 +289,9 @@ class GBDT:
             bundle_db=(tuple(int(m.default_bin) for m in ds.mappers)
                        if self._use_bundles else ()),
             n_shards=(self.n_shards if self.use_dist else 1),
+            voting_top_k=(cfg.top_k if cfg.tree_learner == "voting"
+                          and self.use_dist else 0),
+            feature_fraction_bynode=float(cfg.feature_fraction_bynode),
         )
 
         # grower selection: "wave" (default via auto) applies batched
@@ -340,12 +343,25 @@ class GBDT:
             self.grower = "wave"
         if (self.meta.monotone is not None
                 or self.meta.inter_sets is not None
-                or self.meta.forced is not None) \
+                or self.meta.forced is not None
+                or cfg.feature_fraction_bynode < 1.0) \
                 and self.grower not in ("wave", "wave_exact"):
-            log_warning("monotone/interaction/forced-split constraints are "
-                        "implemented by the wave grower; switching "
-                        "tpu_grower to 'wave'")
+            log_warning("monotone/interaction/forced-split/by-node-"
+                        "sampling features are implemented by the wave "
+                        "grower; switching tpu_grower to 'wave'")
             self.grower = "wave"
+        if cfg.tree_learner == "voting" and self.use_dist:
+            if self.meta.forced is not None \
+                    or bool(ds.feature_is_categorical().any()):
+                log_fatal("tree_learner=voting does not support forced "
+                          "splits or categorical features yet")
+            if self._use_bundles:
+                log_fatal("tree_learner=voting does not support EFB "
+                          "bundling yet; set enable_bundle=false")
+            if self.grower not in ("wave", "wave_exact"):
+                log_warning("tree_learner=voting is implemented by the "
+                            "wave grower; switching tpu_grower to 'wave'")
+                self.grower = "wave"
         # no silently-ignored parameters: fail loudly on parsed-but-
         # unimplemented features (cf. VERDICT: silent drops are worse
         # than absence)

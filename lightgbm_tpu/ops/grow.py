@@ -99,6 +99,18 @@ class GrowConfig(NamedTuple):
     cegb_tradeoff: float = 1.0
     cegb_penalty_split: float = 0.0
 
+    # voting-parallel (PV-Tree, voting_parallel_tree_learner.cpp): each
+    # shard proposes its top-k features by LOCAL gain, a psum vote picks
+    # 2k global candidates, and only those features' histogram columns
+    # are aggregated. 0 = off (full data-parallel reduction).
+    voting_top_k: int = 0
+
+    # per-node column sampling (ColSampler::GetByNode,
+    # col_sampler.hpp:208): each prospective split samples
+    # max(1, fraction * F) features, deterministically keyed by
+    # (seed, wave, child) so every shard draws the same mask
+    feature_fraction_bynode: float = 1.0
+
     @property
     def bundled(self) -> bool:
         return len(self.bundle_col) > 0

@@ -291,7 +291,18 @@ def grow_tree_wave(
     # (SyncUpGlobalBestSplit, parallel_tree_learner.h:210). Histogram
     # comm per wave drops from [K,C,F,B] allreduce-everywhere to a
     # reduce-scatter (1/n received) + O(K) record gather.
-    fo = dist is not None and cfg.n_shards > 1 and not cfg.bundled
+    # voting-parallel (PV-Tree, voting_parallel_tree_learner.cpp): shards
+    # keep LOCAL histograms; per wave each shard votes its top-k features
+    # by local gain, and only the 2k winning features' histogram columns
+    # are psum-aggregated for the (exact-on-voted-features) split search.
+    vo = (dist is not None and cfg.n_shards > 1 and cfg.voting_top_k > 0
+          and not cfg.bundled)
+    if vo and (has_forced or cfg.has_categorical):
+        raise NotImplementedError(
+            "tree_learner=voting does not support forced splits or "
+            "categorical features yet")
+    fo = (dist is not None and cfg.n_shards > 1 and not cfg.bundled
+          and not vo)
     nsh = cfg.n_shards
     if fo:
         from ..utils import round_up
@@ -332,7 +343,7 @@ def grow_tree_wave(
 
     def make_search(meta_use, fmask_use, foffset=0):
       def search(hist2, sum_g, sum_h, count, out, bmin, bmax, sets_row,
-                 forced_id=None, used_f=None):
+                 forced_id=None, used_f=None, fmask_dyn=None):
         if cfg.bundled:
             # EFB: re-slice the bundle histogram per ORIGINAL feature
             # (Dataset::ConstructHistograms offsets) and reconstruct each
@@ -351,6 +362,14 @@ def grow_tree_wave(
         hist = jnp.concatenate([hist2, hist2[1:2] * cntf], axis=0)
         fmask = (sets_to_fmask(sets_row, meta_use, fmask_use)
                  if has_inter else fmask_use)
+        if fmask_dyn is not None:
+            F_use = int(meta_use.num_bins.shape[0])
+            fd = fmask_dyn
+            if fd.shape[0] != F_use:      # sharded search: own slice
+                fd = jax.lax.dynamic_slice_in_dim(
+                    jnp.pad(fd, (0, F_use * nsh - fd.shape[0])),
+                    foffset, F_use, 0)
+            fmask = fd if fmask is None else (fmask & fd)
         pen = None
         if has_cegb and used_f is not None:
             # DeltaGain (cost_effective_gradient_boosting.hpp:81):
@@ -368,11 +387,24 @@ def grow_tree_wave(
             if meta_use.cegb_coupled is not None:
                 pen = pen + cfg.cegb_tradeoff * meta_use.cegb_coupled \
                     * (1.0 - u.astype(jnp.float32))
-        num = find_best_split(hist, sum_g, sum_h, count, out, meta_use, hp,
-                              fmask,
-                              leaf_min=bmin if has_mono else None,
-                              leaf_max=bmax if has_mono else None,
-                              cegb_pen=pen)
+        fres = None
+        if has_forced and forced_id is not None:
+            # one shared gain map yields both the normal best and the
+            # forced (feature, threshold) cell
+            from .split import find_best_split_and_forced
+            fid_c = jnp.clip(forced_id, 0, meta.forced.shape[1] - 1)
+            ff = meta.forced[0, fid_c] - foffset
+            fb = meta.forced[1, fid_c]
+            num, fres = find_best_split_and_forced(
+                hist, sum_g, sum_h, count, out, meta_use, hp, fmask,
+                bmin if has_mono else None,
+                bmax if has_mono else None, ff, fb, cegb_pen=pen)
+        else:
+            num = find_best_split(hist, sum_g, sum_h, count, out,
+                                  meta_use, hp, fmask,
+                                  leaf_min=bmin if has_mono else None,
+                                  leaf_max=bmax if has_mono else None,
+                                  cegb_pen=pen)
         nob = jnp.zeros((W,), jnp.uint32)
         if not cfg.has_categorical:
             merged, use_cat, bits = num, jnp.zeros((), bool), nob
@@ -387,21 +419,12 @@ def grow_tree_wave(
             merged = SplitResult(*[
                 jnp.where(use_cat, cv, nv) for cv, nv in zip(catres, num)])
             bits = jnp.where(use_cat, bitset, nob)
-        if not has_forced or forced_id is None:
+        if fres is None:
             return merged, use_cat, bits, jnp.zeros((), bool)
         # forced-split override: fixed (feature, threshold) from the
-        # forced table; the column sampler does not apply to forced
-        # splits. In sharded search the forced feature may live on
+        # forced table. In sharded search the forced feature may live on
         # another shard (local id out of range -> -inf; the owner wins
         # at merge time).
-        fid_c = jnp.clip(forced_id, 0, meta.forced.shape[1] - 1)
-        ff = meta.forced[0, fid_c] - foffset
-        fb = meta.forced[1, fid_c]
-        fres = find_best_split(
-            hist, sum_g, sum_h, count, out, meta_use, hp, None,
-            leaf_min=bmin if has_mono else None,
-            leaf_max=bmax if has_mono else None,
-            forced_f=ff, forced_b=fb)
         use_f = (forced_id >= 0) & jnp.isfinite(fres.gain)
         merged = SplitResult(*[
             jnp.where(use_f, fv, mv) for fv, mv in zip(fres, merged)])
@@ -411,6 +434,47 @@ def grow_tree_wave(
 
     search = make_search(meta, feature_mask)
     search_sh = make_search(meta_sh, fmask_sh, foff) if fo else search
+
+    # per-node column sampling (ColSampler::GetByNode, col_sampler.hpp:208)
+    bynode = cfg.feature_fraction_bynode < 1.0
+
+    def node_masks(key, n):
+        """[n, F] bool: exactly max(1, fraction*F) features kept per node;
+        the key derives from replicated values so all shards agree."""
+        k_keep = max(1, int(F * cfg.feature_fraction_bynode))
+        u = jax.random.uniform(key, (n, F))
+        kth = -jax.lax.top_k(-u, k_keep)[0][:, -1:]
+        return u <= kth
+
+    if bynode:
+        _bn_seed = rng_seed if rng_seed is not None else jnp.int32(0)
+        _bn_base = jax.random.PRNGKey(_bn_seed + 0x5EED)
+
+    def search_voted(hist2, sum_g, sum_h, count, out, bmin, bmax,
+                     sets_row, mv_nb, mv_mt, mv_db, mv_mono, mv_inter,
+                     mv_fmask):
+        """Split search over the AGGREGATED voted feature columns (exact
+        for voted features: global histograms + global parent stats).
+        Meta arrays arrive gathered per voted feature (dynamic)."""
+        hist2 = to_f32(hist2)
+        cntf = count / jnp.maximum(sum_h, 1e-12)
+        hist = jnp.concatenate([hist2, hist2[1:2] * cntf], axis=0)
+        mv = FeatureMeta(
+            num_bins=mv_nb, missing_type=mv_mt, default_bin=mv_db,
+            is_categorical=jnp.zeros_like(mv_nb, bool),
+            monotone=mv_mono, inter_sets=mv_inter)
+        if has_inter:
+            fmask = jnp.any(mv_inter & sets_row[:, None], axis=0)
+            if mv_fmask is not None:
+                fmask = fmask & mv_fmask
+        else:
+            fmask = mv_fmask
+        res = find_best_split(hist, sum_g, sum_h, count, out, mv, hp,
+                              fmask,
+                              leaf_min=bmin if has_mono else None,
+                              leaf_max=bmax if has_mono else None)
+        return (res, jnp.zeros((), bool), jnp.zeros((W,), jnp.uint32),
+                jnp.zeros((), bool))
 
     def child_sets(bs, psets):
         """Constraint sets still satisfiable in the children: the parent's
@@ -441,13 +505,16 @@ def grow_tree_wave(
         -jnp.sign(root_g) * jnp.maximum(jnp.abs(root_g) - hp.lambda_l1, 0.0)
         / (root_h + hp.lambda_l2), jnp.float32)
 
-    hist_root = psum(build_histogram(X_t, vals0, B, cfg.rows_per_chunk))
+    hist_root_local = build_histogram(X_t, vals0, B, cfg.rows_per_chunk)
+    hist_root = psum(hist_root_local)
     root_fid = jnp.asarray(0 if has_forced else -1, jnp.int32)
     used0 = (cegb_used if has_cegb else jnp.zeros((F,), bool))
     root_split, root_is_cat, root_bitset, root_forced = search(
         hist_root, root_g, root_h, root_c, root_out,
         jnp.float32(-jnp.inf), jnp.float32(jnp.inf),
-        jnp.ones((S,), bool), forced_id=root_fid, used_f=used0)
+        jnp.ones((S,), bool), forced_id=root_fid, used_f=used0,
+        fmask_dyn=(node_masks(jax.random.fold_in(_bn_base, 0), 1)[0]
+                   if bynode else None))
     root_split = root_split._replace(
         gain=jnp.where(max_depth >= 1, root_split.gain, NEG_INF))
     root_forced &= max_depth >= 1
@@ -457,6 +524,10 @@ def grow_tree_wave(
         pads[1] = (0, Fh_pad - hist_root.shape[1])
         hist_cache0 = jax.lax.dynamic_slice_in_dim(
             jnp.pad(hist_root, pads), foff, Fs, 1)
+    elif vo:
+        # voting: caches hold LOCAL histograms (subtraction stays local;
+        # only voted columns ever cross the wire)
+        hist_cache0 = hist_root_local
     else:
         hist_cache0 = hist_root
 
@@ -919,6 +990,8 @@ def grow_tree_wave(
                 pads[2] = (0, Fh_pad - hist_local.shape[2])
                 hist_small = dist.psum_scatter(
                     jnp.pad(hist_local, pads), axis=2)
+            elif vo:
+                hist_small = hist_local     # voting: caches stay local
             else:
                 hist_small = psum(hist_local)
             hist_parent = _onehot_gather(
@@ -953,12 +1026,71 @@ def grow_tree_wave(
             else:
                 fidl_k = fidr_k = jnp.full((KMAX,), -1, jnp.int32)
                 fid_lr = None
-            s_lr, cat_lr, bits_lr, forced_lr = jax.vmap(
-                lambda h_, sg_, sh_, c_, o_, bn_, bx_, st_, fi_:
-                search_sh(h_, sg_, sh_, c_, o_, bn_, bx_, st_, fi_,
-                          used_f=st.feat_used))(
-                hist_lr, sg_lr, sh_lr, c_lr, o_lr, bmin_lr, bmax_lr,
-                sets_lr, fid_lr)
+            if bynode:
+                bn_masks = node_masks(
+                    jax.random.fold_in(_bn_base,
+                                       st.tree.num_waves + 1),
+                    2 * KMAX)                             # [2K, F]
+            if vo:
+                # ---- PV-Tree vote (voting_parallel_tree_learner.cpp):
+                # rank features by LOCAL gain, psum the votes, aggregate
+                # only the 2k winners' histogram columns
+                from .split import per_feature_best_gain
+                kv = cfg.voting_top_k
+                kv2 = min(2 * kv, F)
+                hist_f32 = to_f32(hist_lr)                # [2K, 2, F, B]
+                loc_g = jnp.sum(hist_f32[:, 0, 0, :], axis=-1)
+                loc_h = jnp.sum(hist_f32[:, 1, 0, :], axis=-1)
+                cnt_ratio = c_lr / jnp.maximum(sh_lr, 1e-12)
+                loc_c = loc_h * cnt_ratio
+                cntf3 = cnt_ratio[:, None, None, None]
+                hist3 = jnp.concatenate(
+                    [hist_f32, hist_f32[:, 1:2] * cntf3], axis=1)
+                if bynode:
+                    fm_vote = (bn_masks if feature_mask is None
+                               else bn_masks & feature_mask[None, :])
+                elif feature_mask is not None:
+                    fm_vote = jnp.broadcast_to(feature_mask[None, :],
+                                               (2 * KMAX, F))
+                else:
+                    fm_vote = None
+                lgains = jax.vmap(
+                    lambda h_, g_, hh_, c_, o_, fm_: per_feature_best_gain(
+                        h_, g_, hh_, c_, o_, meta, hp, fm_))(
+                    hist3, loc_g, loc_h, loc_c, o_lr, fm_vote)  # [2K, F]
+                _, topi = jax.lax.top_k(lgains, min(kv, F))
+                fin = jnp.isfinite(jnp.take_along_axis(
+                    lgains, topi, axis=1))
+                iota_f = jnp.arange(F, dtype=jnp.int32)
+                votes = jnp.sum(
+                    (topi[:, :, None] == iota_f[None, None, :])
+                    & fin[:, :, None], axis=1).astype(jnp.float32)
+                votes = psum(votes)                       # [2K, F]
+                # deterministic tie-break toward lower feature ids so
+                # every shard selects the identical voted set
+                score = votes * (F + 1) + (F - iota_f)[None, :]
+                _, vf = jax.lax.top_k(score, kv2)         # [2K, kv2]
+                hv = psum(jnp.take_along_axis(
+                    hist_lr, vf[:, None, :, None], axis=2))
+                mono_v = meta.monotone[vf] if has_mono else None
+                inter_v = (jnp.moveaxis(meta.inter_sets[:, vf], 1, 0)
+                           if has_inter else None)        # [2K, S, kv2]
+                fmask_v = (jnp.take_along_axis(fm_vote, vf, axis=1)
+                           if fm_vote is not None else None)
+                s_lr, cat_lr, bits_lr, forced_lr = jax.vmap(search_voted)(
+                    hv, sg_lr, sh_lr, c_lr, o_lr, bmin_lr, bmax_lr,
+                    sets_lr, meta.num_bins[vf], meta.missing_type[vf],
+                    meta.default_bin[vf], mono_v, inter_v, fmask_v)
+                # voted-local feature index -> global feature id
+                s_lr = s_lr._replace(feature=jnp.take_along_axis(
+                    vf, s_lr.feature[:, None], axis=1)[:, 0])
+            else:
+                s_lr, cat_lr, bits_lr, forced_lr = jax.vmap(
+                    lambda h_, sg_, sh_, c_, o_, bn_, bx_, st_, fi_, fd_:
+                    search_sh(h_, sg_, sh_, c_, o_, bn_, bx_, st_, fi_,
+                              used_f=st.feat_used, fmask_dyn=fd_))(
+                    hist_lr, sg_lr, sh_lr, c_lr, o_lr, bmin_lr, bmax_lr,
+                    sets_lr, fid_lr, bn_masks if bynode else None)
             if fo:
                 # map slice-local feature ids to global, then merge the
                 # per-shard bests by SELECTION KEY (a forced split must

@@ -123,34 +123,13 @@ def leaf_gain(sum_g, sum_h, hp: SplitHyperParams, num_data, parent_output):
     return leaf_gain_given_output(sum_g, sum_h, hp, out)
 
 
-def find_best_split(
-    hist: jnp.ndarray,          # [3, F, B] float32: (sum_g, sum_h, count)
-    parent_sum_g: jnp.ndarray,  # scalar
-    parent_sum_h: jnp.ndarray,
-    parent_count: jnp.ndarray,
-    parent_output: jnp.ndarray,
-    meta: FeatureMeta,
-    hp: SplitHyperParams,
-    feature_mask: jnp.ndarray | None = None,  # [F] bool (col sampling)
-    leaf_min: jnp.ndarray | None = None,      # scalar: monotone lower bound
-    leaf_max: jnp.ndarray | None = None,      # scalar: monotone upper bound
-    forced_f: jnp.ndarray | None = None,      # scalar i32: forced feature
-    forced_b: jnp.ndarray | None = None,      # scalar i32: forced threshold
-    cegb_pen: jnp.ndarray | None = None,      # [F] f32: CEGB gain penalty
-) -> SplitResult:
-    """Best numerical split over all features for one leaf.
-
-    Returns gain == -inf when no split satisfies the constraints. Categorical
-    features are handled by `find_best_split_categorical` (ops/categorical.py)
-    and masked out here.
-
-    Monotone constraints follow the reference's "basic" method
-    (BasicConstraint / LeafConstraintsBase::Create,
-    monotone_constraints.hpp:330): child outputs are clamped into the
-    leaf's [leaf_min, leaf_max] bounds inherited from monotone ancestors,
-    and splits on a +-1 monotone feature whose (clamped) child outputs
-    violate the direction are rejected.
-    """
+def _numeric_gain_map(hist, parent_sum_g, parent_sum_h, parent_count,
+                      parent_output, meta, hp, feature_mask, leaf_min,
+                      leaf_max):
+    """Numerical split-gain map shared by the best-split argmax and the
+    voting-parallel per-feature ranking: returns
+    (gain [2, F, B] with -inf where invalid/below min-gain, ok mask,
+    (lg, lh, lc, rg, rh, rc, lout, rout) stat maps, min_gain_shift)."""
     _, F, B = hist.shape
     bins = jnp.arange(B, dtype=jnp.int32)[None, :]          # [1, B]
     nb = meta.num_bins[:, None]                              # [F, 1]
@@ -193,7 +172,8 @@ def find_best_split(
     # reference exactly and avoids duplicate thresholds)
     skip_default = (meta.missing_type == MISSING_ZERO)[:, None] & \
         (bins == meta.default_bin[:, None])
-    t_ok = jnp.stack([t_ok_f & ~skip_default, t_ok_r & ~skip_default], axis=0)
+    t_ok = jnp.stack([t_ok_f & ~skip_default, t_ok_r & ~skip_default],
+                     axis=0)
 
     ok = (t_ok
           & (lc >= hp.min_data_in_leaf) & (rc >= hp.min_data_in_leaf)
@@ -220,6 +200,63 @@ def find_best_split(
     gain_shift = leaf_gain(parent_sum_g, parent_sum_h, hp,
                            parent_count, parent_output)
     min_gain_shift = gain_shift + hp.min_gain_to_split
+    return gain, ok, (lg, lh, lc, rg, rh, rc, lout, rout), min_gain_shift
+
+
+def per_feature_best_gain(
+    hist: jnp.ndarray,          # [3, F, B]
+    parent_sum_g: jnp.ndarray,
+    parent_sum_h: jnp.ndarray,
+    parent_count: jnp.ndarray,
+    parent_output: jnp.ndarray,
+    meta: FeatureMeta,
+    hp: SplitHyperParams,
+    feature_mask: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """[F] best numerical split gain per feature (-inf where none valid):
+    the local ranking signal for the voting-parallel learner's top-k
+    proposal (PV-Tree local voting, voting_parallel_tree_learner.cpp)."""
+    gain, ok, _, min_gain_shift = _numeric_gain_map(
+        hist, parent_sum_g, parent_sum_h, parent_count, parent_output,
+        meta, hp, feature_mask, None, None)
+    gain = jnp.where(ok & (gain > min_gain_shift), gain, NEG_INF)
+    return jnp.max(gain, axis=(0, 2)) - min_gain_shift
+
+
+def find_best_split(
+    hist: jnp.ndarray,          # [3, F, B] float32: (sum_g, sum_h, count)
+    parent_sum_g: jnp.ndarray,  # scalar
+    parent_sum_h: jnp.ndarray,
+    parent_count: jnp.ndarray,
+    parent_output: jnp.ndarray,
+    meta: FeatureMeta,
+    hp: SplitHyperParams,
+    feature_mask: jnp.ndarray | None = None,  # [F] bool (col sampling)
+    leaf_min: jnp.ndarray | None = None,      # scalar: monotone lower bound
+    leaf_max: jnp.ndarray | None = None,      # scalar: monotone upper bound
+    forced_f: jnp.ndarray | None = None,      # scalar i32: forced feature
+    forced_b: jnp.ndarray | None = None,      # scalar i32: forced threshold
+    cegb_pen: jnp.ndarray | None = None,      # [F] f32: CEGB gain penalty
+) -> SplitResult:
+    """Best numerical split over all features for one leaf.
+
+    Returns gain == -inf when no split satisfies the constraints. Categorical
+    features are handled by `find_best_split_categorical` (ops/categorical.py)
+    and masked out here.
+
+    Monotone constraints follow the reference's "basic" method
+    (BasicConstraint / LeafConstraintsBase::Create,
+    monotone_constraints.hpp:330): child outputs are clamped into the
+    leaf's [leaf_min, leaf_max] bounds inherited from monotone ancestors,
+    and splits on a +-1 monotone feature whose (clamped) child outputs
+    violate the direction are rejected.
+    """
+    (gain, ok, stats, min_gain_shift) = _numeric_gain_map(
+        hist, parent_sum_g, parent_sum_h, parent_count, parent_output,
+        meta, hp, feature_mask, leaf_min, leaf_max)
+    lg, lh, lc, rg, rh, rc, lout, rout = stats
+    _, F, B = hist.shape
+    bins = jnp.arange(B, dtype=jnp.int32)[None, :]          # [1, B]
 
     if forced_f is not None:
         # forced-split mode (SerialTreeLearner::ForceSplits,
@@ -240,6 +277,12 @@ def find_best_split(
         gain = jnp.where(jnp.isfinite(gain),
                          gain - cegb_pen[None, :, None], gain)
 
+    return _pick_best(gain, stats, F, B, min_gain_shift)
+
+
+def _pick_best(gain, stats, F, B, min_gain_shift) -> SplitResult:
+    """Argmax over a filtered [2, F, B] gain map + exact stat selection."""
+    lg, lh, lc, rg, rh, rc, lout, rout = stats
     flat = gain.reshape(-1)
     best = jnp.argmax(flat)
     best_gain = flat[best]
@@ -274,3 +317,33 @@ def find_best_split(
         right_sum_g=picked[3], right_sum_h=picked[4], right_count=picked[5],
         left_output=picked[6], right_output=picked[7],
     )
+
+
+def find_best_split_and_forced(
+    hist, parent_sum_g, parent_sum_h, parent_count, parent_output,
+    meta: FeatureMeta, hp: SplitHyperParams,
+    feature_mask: jnp.ndarray | None,
+    leaf_min, leaf_max,
+    forced_f: jnp.ndarray, forced_b: jnp.ndarray,
+    cegb_pen: jnp.ndarray | None = None,
+) -> tuple[SplitResult, SplitResult]:
+    """Best numerical split AND the fixed forced-(feature, threshold)
+    split from ONE gain-map computation (the map is the expensive part;
+    the forced cell is just a different selection mask). The column
+    sampler applies only to the normal selection — forced splits bypass
+    it (ForceSplits, serial_tree_learner.cpp:628)."""
+    gain, ok, stats, min_gain_shift = _numeric_gain_map(
+        hist, parent_sum_g, parent_sum_h, parent_count, parent_output,
+        meta, hp, None, leaf_min, leaf_max)
+    _, F, B = hist.shape
+    bins = jnp.arange(B, dtype=jnp.int32)[None, :]
+    ok_n = ok if feature_mask is None else (ok & feature_mask[None, :, None])
+    gain_n = jnp.where(ok_n & (gain > min_gain_shift), gain, NEG_INF)
+    if cegb_pen is not None:
+        gain_n = jnp.where(jnp.isfinite(gain_n),
+                           gain_n - cegb_pen[None, :, None], gain_n)
+    restrict = ((jnp.arange(F, dtype=jnp.int32) == forced_f)[:, None]
+                & (bins == forced_b))
+    gain_f = jnp.where(ok & restrict[None, :, :], gain, NEG_INF)
+    return (_pick_best(gain_n, stats, F, B, min_gain_shift),
+            _pick_best(gain_f, stats, F, B, min_gain_shift))

@@ -96,3 +96,26 @@ def test_unimplemented_params_fail_loudly(data):
         with pytest.raises(FatalError):
             lgb.train(dict(objective="regression", verbose=-1, **bad),
                       lgb.Dataset(X, label=y), num_boost_round=1)
+
+
+def test_feature_fraction_bynode(data):
+    """ColSampler::GetByNode (col_sampler.hpp:208): per-node column
+    sampling — trees use a diverse feature set and training still
+    learns; deterministic for a fixed seed."""
+    X, y = data
+    params = dict(objective="regression", num_leaves=15, verbose=-1,
+                  min_data_in_leaf=5, feature_fraction_bynode=0.5,
+                  seed=3)
+    b1 = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    b2 = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    np.testing.assert_allclose(b1.predict(X[:50]), b2.predict(X[:50]),
+                               rtol=1e-12)
+    # sampling by node: within one tree, sibling subtrees can split on
+    # features a per-tree mask would have excluded; weak check — model
+    # trains and uses more than one feature
+    used = set()
+    for t in b1._gbdt.models:
+        used.update(t.split_feature[:t.num_leaves - 1].tolist())
+    assert len(used) >= 2
+    mse = float(np.mean((b1.predict(X) - y) ** 2))
+    assert mse < float(np.var(y))
