@@ -49,31 +49,60 @@ def load_text_file(path: str, has_header: bool = False,
     """Returns (X, label, weight, group_sizes, feature_names)."""
     if not os.path.exists(path):
         log_fatal(f"Data file {path} does not exist")
-    with open(path) as f:
-        lines = [ln.rstrip("\n") for ln in f if ln.strip()]
-    if not lines:
+    # sniff the format from the head of the file only; the full read
+    # stays as bytes so the native parser can consume it zero-copy
+    with open(path, "rb") as f:
+        raw = f.read()
+    if not raw.strip():
         log_fatal(f"Data file {path} is empty")
 
+    def _pop_line(buf: bytes):
+        """(first non-blank line decoded IN FULL, rest) — no 64KB
+        truncation, leading blank/whitespace-only lines dropped."""
+        while True:
+            nl = buf.find(b"\n")
+            first = buf if nl < 0 else buf[:nl]
+            rest = b"" if nl < 0 else buf[nl + 1:]
+            if first.strip():
+                return first.decode(errors="replace").rstrip("\r"), rest
+            if nl < 0:
+                return "", b""
+            buf = rest
+
+    head = [ln for ln in raw[:65536].decode(errors="replace").splitlines()
+            if ln.strip()]
+
     header_names: Optional[List[str]] = None
-    fmt = _detect_format(lines[1 if has_header else 0:][:3] or lines[:1])
+    fmt = _detect_format(head[1 if has_header else 0:][:3] or head[:1])
     if has_header:
-        sep = {"csv": ",", "tsv": "\t"}.get(fmt, None)
-        header_names = lines[0].split(sep) if sep else lines[0].split()
-        lines = lines[1:]
+        sep_h = {"csv": ",", "tsv": "\t"}.get(fmt, None)
+        header_line, raw = _pop_line(raw)
+        header_names = (header_line.split(sep_h) if sep_h
+                        else header_line.split())
 
     if fmt == "libsvm":
+        lines = [ln for ln in raw.decode().splitlines() if ln.strip()]
         return _load_libsvm(lines)
 
     sep = "," if fmt == "csv" else "\t"
-    rows = [ln.split(sep) for ln in lines]
-    ncol = max(len(r) for r in rows)
-    data = np.full((len(rows), ncol), np.nan, dtype=np.float64)
-    for i, r in enumerate(rows):
-        for j, tok in enumerate(r):
-            tok = tok.strip()
-            if tok in ("", "na", "NA", "nan", "NaN", "null", "NULL", "?"):
-                continue
-            data[i, j] = float(tok)
+    # native OpenMP parser (lightgbm_tpu/native/loader.cpp — the
+    # reference's C++ Parser/fast_double_parser analog); falls back to
+    # the Python loop without a toolchain
+    from ..native import parse_text
+    data = parse_text(raw, sep)
+    if data is None:
+        lines = [ln for ln in raw.decode().splitlines() if ln.strip()]
+        rows = [ln.split(sep) for ln in lines]
+        ncol = max(len(r) for r in rows)
+        data = np.full((len(rows), ncol), np.nan, dtype=np.float64)
+        for i, r in enumerate(rows):
+            for j, tok in enumerate(r):
+                tok = tok.strip()
+                if tok in ("", "na", "NA", "nan", "NaN", "null", "NULL",
+                           "?"):
+                    continue
+                data[i, j] = float(tok)
+    ncol = data.shape[1]
 
     label_idx = _parse_column_spec(label_column, header_names) \
         if label_column else 0

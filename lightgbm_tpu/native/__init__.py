@@ -1,0 +1,111 @@
+"""Native (C++) runtime components, built on demand with the system
+toolchain and loaded through ctypes (no pybind11 dependency).
+
+The reference keeps its ingestion stack in C++ because text parsing is
+the CPU-bound half of training start-up (src/io/dataset_loader.cpp,
+src/io/parser.cpp + vendored fast_double_parser). `lgbtpu_native.so`
+carries the same hot loops for the TPU build: an OpenMP two-pass CSV/TSV
+parser and a batch value->bin binary search. Everything degrades to the
+pure-Python implementations when no compiler is available
+(LIGHTGBM_TPU_DISABLE_NATIVE=1 forces the fallback).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_LOCK = threading.Lock()
+_LIB = None
+_TRIED = False
+
+_SRC = os.path.join(os.path.dirname(__file__), "loader.cpp")
+_SO = os.path.join(os.path.dirname(__file__), "lgbtpu_native.so")
+
+
+def _build() -> bool:
+    # compile to a process-unique temp path, then rename atomically:
+    # concurrent processes (multi-process distributed training) must
+    # never observe a truncated .so
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-fopenmp", "-shared", "-fPIC", "-o", tmp, _SRC]
+    try:
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        if r.returncode != 0:
+            return False
+        os.replace(tmp, _SO)
+        return True
+    except (OSError, subprocess.TimeoutExpired):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def get_lib():
+    """The loaded native library, or None (disabled / no toolchain)."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("LIGHTGBM_TPU_DISABLE_NATIVE", "").lower() in (
+                "1", "true", "yes"):
+            return None
+        if not os.path.exists(_SO) or (
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.lgbtpu_scan.restype = ctypes.c_int
+        lib.lgbtpu_scan.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+        lib.lgbtpu_line_starts.restype = ctypes.c_int64
+        lib.lgbtpu_line_starts.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int64]
+        lib.lgbtpu_parse.restype = ctypes.c_int
+        lib.lgbtpu_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p]
+        lib.lgbtpu_value_to_bin.restype = None
+        lib.lgbtpu_value_to_bin.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_void_p]
+        _LIB = lib
+        return _LIB
+
+
+def parse_text(data: bytes, sep: str) -> np.ndarray:
+    """Parse separated numeric text -> [rows, cols] f64 (NaN for missing
+    fields). Returns None if the native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(data)
+    nr = ctypes.c_int64()
+    nc = ctypes.c_int64()
+    lib.lgbtpu_scan(data, n, sep.encode()[0], ctypes.byref(nr),
+                    ctypes.byref(nc))
+    rows, cols = nr.value, nc.value
+    if rows == 0:
+        return np.zeros((0, 0))
+    starts = np.zeros(rows, np.int64)
+    lib.lgbtpu_line_starts(data, n, starts.ctypes.data, rows)
+    out = np.empty((rows, cols), np.float64)
+    lib.lgbtpu_parse(data, n, sep.encode()[0], starts.ctypes.data,
+                     rows, cols, out.ctypes.data)
+    return out

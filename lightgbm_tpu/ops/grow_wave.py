@@ -1054,6 +1054,14 @@ def grow_tree_wave(
                                                (2 * KMAX, F))
                 else:
                     fm_vote = None
+                if has_inter:
+                    # votes must respect each node's active constraint
+                    # sets, or the voted 2k features could all be
+                    # unsplittable for that node
+                    allowed = (sets_lr.astype(jnp.float32)
+                               @ meta.inter_sets.astype(jnp.float32)) > 0
+                    fm_vote = (allowed if fm_vote is None
+                               else fm_vote & allowed)
                 lgains = jax.vmap(
                     lambda h_, g_, hh_, c_, o_, fm_: per_feature_best_gain(
                         h_, g_, hh_, c_, o_, meta, hp, fm_))(

@@ -1,0 +1,101 @@
+"""Native C++ text parser vs the Python fallback (reference analog:
+src/io/parser.cpp + fast_double_parser)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.native import get_lib, parse_text
+
+
+pytestmark = pytest.mark.skipif(get_lib() is None,
+                                reason="no native toolchain")
+
+
+def test_parse_matches_python():
+    text = ("1.5,2,3\n"
+            "-0.25,na,4e2\n"
+            "NaN, 7 ,?\n"
+            "\n"
+            "8,9,10\n")
+    got = parse_text(text.encode(), ",")
+    want = np.array([[1.5, 2, 3],
+                     [-0.25, np.nan, 400.0],
+                     [np.nan, 7, np.nan],
+                     [8, 9, 10]])
+    np.testing.assert_allclose(got, want)
+
+
+def test_parse_ragged_rows_nan_padded():
+    got = parse_text(b"1,2\n3\n4,5,6\n", ",")
+    assert got.shape == (3, 3)
+    assert np.isnan(got[0, 2]) and np.isnan(got[1, 1])
+    np.testing.assert_allclose(got[2], [4, 5, 6])
+
+
+def test_parse_tsv_and_large_random():
+    rng = np.random.RandomState(0)
+    M = rng.normal(size=(2000, 7))
+    M[rng.rand(*M.shape) < 0.05] = np.nan
+    lines = []
+    for row in M:
+        lines.append("\t".join("" if np.isnan(v) else repr(float(v))
+                               for v in row))
+    got = parse_text(("\n".join(lines)).encode(), "\t")
+    np.testing.assert_allclose(got, M, rtol=1e-15, equal_nan=True)
+
+
+def test_value_to_bin_matches_numpy():
+    import ctypes
+    lib = get_lib()
+    rng = np.random.RandomState(1)
+    uppers = np.sort(rng.normal(size=15)).astype(np.float64)
+    uppers[-1] = np.inf
+    vals = rng.normal(size=10_000).astype(np.float64)
+    out = np.zeros(len(vals), np.uint8)
+    lib.lgbtpu_value_to_bin(vals.ctypes.data, len(vals),
+                            uppers.ctypes.data, len(uppers),
+                            len(uppers), 0, 0, out.ctypes.data)
+    want = np.searchsorted(uppers, vals, side="left")
+    # searchsorted(left) differs at exact boundary values; none here
+    np.testing.assert_array_equal(out, want)
+
+
+def test_end_to_end_text_training(tmp_path):
+    rng = np.random.RandomState(5)
+    X = rng.normal(size=(1200, 4))
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    p = str(tmp_path / "t.csv")
+    with open(p, "w") as f:
+        for i in range(len(y)):
+            f.write(",".join([str(float(y[i]))]
+                             + [f"{v:.6f}" for v in X[i]]) + "\n")
+    import lightgbm_tpu as lgb
+    bst = lgb.train({"objective": "binary", "verbose": -1,
+                     "num_leaves": 15}, lgb.Dataset(p),
+                    num_boost_round=5)
+    acc = np.mean((bst.predict(X) > 0.5) == (y > 0.5))
+    assert acc > 0.9
+
+
+def test_blank_and_whitespace_lines_match_python(tmp_path):
+    text = "1,2\n \n3,4\n\t\n\n5,6\n"
+    got = parse_text(text.encode(), ",")
+    assert got.shape == (3, 2)
+    np.testing.assert_allclose(got, [[1, 2], [3, 4], [5, 6]])
+
+
+def test_long_fields_parse():
+    long_val = "0." + "3" * 100
+    got = parse_text(f"{long_val},2\n".encode(), ",")
+    np.testing.assert_allclose(got[0, 0], float(long_val))
+
+
+def test_header_with_leading_blank_line(tmp_path):
+    p = str(tmp_path / "h.csv")
+    with open(p, "w") as f:
+        f.write("\nlabel,a,b\n1,2.0,3.0\n0,4.0,5.0\n")
+    from lightgbm_tpu.data.loader import load_text_file
+    X, y, _, _, names = load_text_file(p, has_header=True)
+    assert names == ["a", "b"]
+    np.testing.assert_allclose(y, [1, 0])
+    np.testing.assert_allclose(X, [[2, 3], [4, 5]])
