@@ -351,6 +351,96 @@ class Dataset:
                 None if init_score is None else np.asarray(init_score))
         return self
 
+    def subset(self, used_indices, params=None) -> "Dataset":
+        """Row-subset Dataset sharing this one's bin mappers
+        (reference: basic.py Dataset.subset -> Dataset::CopySubrow,
+        dataset.h:674 — the bagging/CV subset path: no re-binning)."""
+        self.construct()
+        h = self._handle
+        idx = np.asarray(used_indices, np.int64)
+        sub = Dataset(None, params=(params if params is not None
+                                    else self.params),
+                      free_raw_data=self.free_raw_data)
+        nh = BinnedDataset()
+        nh.num_data = int(len(idx))
+        nh.num_total_features = h.num_total_features
+        nh.mappers = h.mappers
+        nh.real_feature_index = h.real_feature_index
+        nh.used_feature_map = h.used_feature_map
+        nh.feature_names = list(h.feature_names)
+        nh.max_bin = h.max_bin
+        nh.reference = h
+        nh.X_binned = h.X_binned[idx]
+        from .data.dataset import Metadata
+        md = Metadata(nh.num_data)
+        if h.metadata.label is not None:
+            md.set_label(h.metadata.label[idx])
+        if h.metadata.weight is not None:
+            md.set_weight(h.metadata.weight[idx])
+        if h.metadata.init_score is not None:
+            ins = np.asarray(h.metadata.init_score).reshape(-1)
+            if ins.size == h.num_data:
+                md.set_init_score(ins[idx])
+            else:   # per-class init scores, class-major
+                k = ins.size // h.num_data
+                md.set_init_score(
+                    ins.reshape(k, h.num_data)[:, idx].reshape(-1))
+        # query boundaries survive whole-query subsets (the bagging-by-
+        # query case CopySubrow serves); partial queries can't be
+        # represented and are dropped with a warning
+        if h.metadata.query_boundaries is not None:
+            qb = np.asarray(h.metadata.query_boundaries)
+            qid = np.searchsorted(qb, idx, side="right") - 1
+            sel_q, counts = np.unique(qid, return_counts=True)
+            full = np.all(counts == np.diff(qb)[sel_q])
+            contiguous = np.all(np.diff(qid) >= 0)
+            if full and contiguous:
+                md.set_group(counts)
+            else:
+                log_warning("Dataset.subset dropped query boundaries: "
+                            "the row subset does not keep queries whole")
+        nh.metadata = md
+        sub._handle = nh
+        return sub
+
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Append `other`'s features to this dataset in place
+        (reference: basic.py Dataset.add_features_from ->
+        Dataset::AddFeaturesFrom, dataset.h:971). Both sides must be
+        constructed with the same row count; `other`'s bin mappers ride
+        along, EFB bundles are dropped (re-bundled on next use)."""
+        self.construct()
+        other.construct()
+        h, o = self._handle, other._handle
+        if h.num_data != o.num_data:
+            log_fatal("Cannot add features from a Dataset with "
+                      f"{o.num_data} rows to one with {h.num_data}")
+        off = h.num_total_features          # original-column offset
+        inner_off = len(h.mappers)          # inner-feature offset
+        h.X_binned = np.concatenate([h.X_binned[:, :len(h.mappers)],
+                                     o.X_binned[:, :len(o.mappers)]],
+                                    axis=1)
+        h.mappers = list(h.mappers) + list(o.mappers)
+        h.real_feature_index = list(h.real_feature_index) + [
+            off + r for r in o.real_feature_index]
+        h.used_feature_map = list(h.used_feature_map) + [
+            (-1 if m < 0 else m + inner_off) for m in o.used_feature_map]
+        # re-number default names and de-collide user names so name-based
+        # column specs stay unambiguous
+        new_names = []
+        existing = set(h.feature_names)
+        for r, name in enumerate(o.feature_names):
+            if name == f"Column_{r}":
+                name = f"Column_{off + r}"
+            while name in existing:
+                name = name + "_y"
+            existing.add(name)
+            new_names.append(name)
+        h.feature_names = list(h.feature_names) + new_names
+        h.num_total_features = off + o.num_total_features
+        h.bundles = h.X_bundled = h.bundle_col = h.bundle_off = None
+        return self
+
     # -- streaming push ingestion --------------------------------------
     def init_streaming(self, num_rows: int,
                        reference: Optional["Dataset"] = None) -> "Dataset":

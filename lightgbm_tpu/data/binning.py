@@ -332,6 +332,9 @@ class BinMapper:
             hit = keys[pos] == iv
             out = np.where(hit, vals[pos], 0).astype(np.int32)
             return out
+        bins = self._native_value_to_bin(values)
+        if bins is not None:
+            return bins
         nan_mask = np.isnan(values)
         if self.missing_type == MISSING_NAN:
             v = np.where(nan_mask, 0.0, values)
@@ -347,6 +350,33 @@ class BinMapper:
             bins = np.searchsorted(self.bin_upper_bound, v, side="left")
             bins = np.minimum(bins, self.num_bin - 1)
         return bins.astype(np.int32)
+
+    def _native_value_to_bin(self, values: np.ndarray):
+        """OpenMP value->bin for large numeric columns (lgbtpu_value_to_bin
+        in native/loader.cpp — the ingestion-side ValueToBin hot loop,
+        bin.h:613); None = use the NumPy path."""
+        if len(values) < 65536 or self.num_bin > 256:
+            return None
+        from ..native import get_lib
+        lib = get_lib()
+        if lib is None:
+            return None
+        if self.missing_type == MISSING_NAN:
+            ub = np.ascontiguousarray(self.bin_upper_bound[:-1],
+                                      np.float64)
+            nan_bin = self.num_bin - 1
+        else:
+            ub = np.ascontiguousarray(self.bin_upper_bound, np.float64)
+            # NaN maps to the bin holding 0.0 (the NumPy path's
+            # where(nan, 0.0, v) semantics)
+            nan_bin = int(min(np.searchsorted(ub, 0.0, side="left"),
+                              self.num_bin - 1))
+        vals = np.ascontiguousarray(values, np.float64)
+        out = np.empty(len(vals), np.uint8)
+        lib.lgbtpu_value_to_bin(vals.ctypes.data, len(vals),
+                                ub.ctypes.data, len(ub), nan_bin, 0, 0,
+                                out.ctypes.data)
+        return out.astype(np.int32)
 
     def bin_to_value(self, bin_idx: int) -> float:
         """Real-valued threshold for a bin (the model file stores bin upper

@@ -101,7 +101,12 @@ def load_text_file(path: str, has_header: bool = False,
                 if tok in ("", "na", "NA", "nan", "NaN", "null", "NULL",
                            "?"):
                     continue
-                data[i, j] = float(tok)
+                try:
+                    data[i, j] = float(tok)
+                except ValueError:
+                    # permissive like the native strtod path and the
+                    # reference's Common::Atof: unparseable -> NaN
+                    pass
     ncol = data.shape[1]
 
     label_idx = _parse_column_spec(label_column, header_names) \

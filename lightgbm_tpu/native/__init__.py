@@ -70,7 +70,9 @@ def get_lib():
         lib.lgbtpu_scan.restype = ctypes.c_int
         lib.lgbtpu_scan.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
-            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_void_p, ctypes.c_int64]
         lib.lgbtpu_line_starts.restype = ctypes.c_int64
         lib.lgbtpu_line_starts.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_void_p,
@@ -98,13 +100,15 @@ def parse_text(data: bytes, sep: str) -> np.ndarray:
     n = len(data)
     nr = ctypes.c_int64()
     nc = ctypes.c_int64()
+    # upper-bound the line count from the newline count so the offsets
+    # fill in the same serial pass as the row/column scan
+    cap = data.count(b"\n") + 1
+    starts = np.zeros(max(cap, 1), np.int64)
     lib.lgbtpu_scan(data, n, sep.encode()[0], ctypes.byref(nr),
-                    ctypes.byref(nc))
+                    ctypes.byref(nc), starts.ctypes.data, cap)
     rows, cols = nr.value, nc.value
     if rows == 0:
         return np.zeros((0, 0))
-    starts = np.zeros(rows, np.int64)
-    lib.lgbtpu_line_starts(data, n, starts.ctypes.data, rows)
     out = np.empty((rows, cols), np.float64)
     lib.lgbtpu_parse(data, n, sep.encode()[0], starts.ctypes.data,
                      rows, cols, out.ctypes.data)

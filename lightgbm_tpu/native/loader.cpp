@@ -19,6 +19,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <locale.h>
 #include <vector>
 
 #if defined(_OPENMP)
@@ -40,6 +41,14 @@ bool is_missing_token(const char* s, const char* end) {
   return false;
 }
 
+// Locale-independent strtod: the host process may have set a non-C
+// LC_NUMERIC (the reference vendors fast_double_parser for the same
+// reason — '1.5' must never parse as 1.0 under de_DE).
+locale_t c_locale() {
+  static locale_t loc = newlocale(LC_ALL_MASK, "C", nullptr);
+  return loc;
+}
+
 // Whitespace-only lines are blank (the Python loader's `ln.strip()`
 // semantics): peek from a line start — true if nothing but spaces/tabs/
 // CR before the newline.
@@ -56,10 +65,11 @@ bool line_is_blank(const char* buf, int64_t len, int64_t i) {
 
 extern "C" {
 
-// Scan the buffer once: number of non-blank lines and the maximum field
-// count per line. Returns 0 on success.
+// ONE serial pass: count non-blank lines, the max field count, and the
+// line-start offsets (into `offsets`, capacity `cap` — the caller sizes
+// it from the newline count, so one pass suffices). Returns 0.
 int lgbtpu_scan(const char* buf, int64_t len, char sep, int64_t* n_rows,
-                int64_t* n_cols) {
+                int64_t* n_cols, int64_t* offsets, int64_t cap) {
   int64_t rows = 0, cols = 0;
   int64_t i = 0;
   while (i < len) {
@@ -68,6 +78,7 @@ int lgbtpu_scan(const char* buf, int64_t len, char sep, int64_t* n_rows,
       ++i;
       continue;
     }
+    if (offsets != nullptr && rows < cap) offsets[rows] = i;
     int64_t c = 1;
     while (i < len && buf[i] != '\n') {
       if (buf[i] == sep) ++c;
@@ -112,7 +123,7 @@ int lgbtpu_parse(const char* buf, int64_t len, char sep,
         // tokens stay NaN — prefix-permissive like the reference's
         // Common::Atof parser.
         char* endp = nullptr;
-        double v = strtod(a, &endp);
+        double v = strtod_l(a, &endp, c_locale());
         if (endp != a) row[c] = v;
       }
       ++c;

@@ -105,3 +105,33 @@ def test_text_file_load(tmp_path, xy):
     bst = lgb.train({"objective": "binary", "num_leaves": 15,
                      "verbose": -1}, ds, num_boost_round=3)
     assert bst.predict(X[:10]).shape == (10,)
+
+
+def test_subset_shares_mappers_and_trains(xy):
+    X, y = xy
+    full = lgb.Dataset(X, label=y)
+    full.construct()
+    idx = np.arange(0, 5000, 2)
+    sub = full.subset(idx)
+    np.testing.assert_array_equal(sub._handle.X_binned,
+                                  full._handle.X_binned[idx])
+    np.testing.assert_allclose(sub._handle.metadata.label, y[idx])
+    assert sub._handle.mappers is full._handle.mappers
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1}, sub, num_boost_round=3)
+    assert bst.predict(X[:10]).shape == (10,)
+
+
+def test_add_features_from(xy):
+    X, y = xy
+    a = lgb.Dataset(X[:, :4], label=y)
+    b = lgb.Dataset(X[:, 4:])
+    a.add_features_from(b)
+    both = lgb.Dataset(X, label=y)
+    both.construct()
+    assert a._handle.num_total_features == X.shape[1]
+    np.testing.assert_array_equal(a._handle.X_binned,
+                                  both._handle.X_binned)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1}, a, num_boost_round=3)
+    assert bst.predict(X[:10]).shape == (10,)

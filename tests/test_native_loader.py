@@ -99,3 +99,30 @@ def test_header_with_leading_blank_line(tmp_path):
     assert names == ["a", "b"]
     np.testing.assert_allclose(y, [1, 0])
     np.testing.assert_allclose(X, [[2, 3], [4, 5]])
+
+
+def test_native_value_to_bin_matches_numpy_mapper():
+    import os
+    from lightgbm_tpu.data.binning import BinMapper
+    rng = np.random.RandomState(9)
+    col = rng.normal(size=200_000)
+    col[rng.rand(len(col)) < 0.03] = np.nan
+    m = BinMapper.find_bin(col[:50_000], total_sample_cnt=50_000,
+                           max_bin=63, min_data_in_bin=3,
+                           min_split_data=5, pre_filter=False)
+    native = m.value_to_bin(col)             # len >= 65536 -> native
+    got_small = m.value_to_bin(col[:1000])   # < threshold -> numpy
+    ref = m._native_value_to_bin.__wrapped__(m, col) \
+        if hasattr(m._native_value_to_bin, "__wrapped__") else None
+    # force the numpy path for the full column
+    os.environ["LIGHTGBM_TPU_DISABLE_NATIVE"] = "1"
+    import lightgbm_tpu.native as nat
+    old = (nat._LIB, nat._TRIED)
+    nat._LIB, nat._TRIED = None, True
+    try:
+        ref = m.value_to_bin(col)
+    finally:
+        nat._LIB, nat._TRIED = old
+        del os.environ["LIGHTGBM_TPU_DISABLE_NATIVE"]
+    np.testing.assert_array_equal(native, ref)
+    np.testing.assert_array_equal(got_small, ref[:1000])
