@@ -68,6 +68,22 @@ def main() -> None:
     barrier(booster)
     dt = time.perf_counter() - t0
 
+    # train AUC over the 2x iters trained so far: guards against "fast but
+    # wrong" — a kernel change that hurt split quality would show up here
+    sub = slice(0, min(rows, 500_000))
+    pred = np.asarray(booster._gbdt.scores[0][:rows][sub])
+    lab = y[sub]
+    # tie-averaged ranks (plain argsort ranks would make the metric depend
+    # on the arbitrary order of tied predictions)
+    uniq, inv = np.unique(pred, return_inverse=True)
+    counts = np.bincount(inv)
+    ends = np.cumsum(counts)
+    mid = ends - (counts - 1) / 2.0
+    ranks = mid[inv]
+    npos = lab.sum()
+    auc = (ranks[lab > 0].sum() - npos * (npos + 1) / 2) \
+        / max(npos * (lab.size - npos), 1)
+
     row_iters_per_sec = rows * iters / dt
     print(json.dumps({
         "metric": "binary_train_throughput",
@@ -75,6 +91,7 @@ def main() -> None:
         "unit": "row_iters_per_sec",
         "vs_baseline": round(row_iters_per_sec / BASELINE_ROW_ITERS_PER_SEC,
                              4),
+        "train_auc": round(float(auc), 5),
     }))
 
 

@@ -321,7 +321,7 @@ class Config:
     # wave grower: a ready leaf splits only if its gain >= slack * (best
     # frontier gain); raises order fidelity vs strict leaf-wise (see
     # ops/grow.py GrowConfig.wave_gain_slack)
-    tpu_wave_gain_slack: float = 0.4
+    tpu_wave_gain_slack: float = 0.3
     tpu_num_shards: int = 0            # 0 = use all local devices for data ||
 
     def __post_init__(self) -> None:

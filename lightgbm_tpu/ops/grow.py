@@ -230,13 +230,21 @@ def grow_tree(
 
     g = grad.astype(jnp.float32) * in_bag
     h = hess.astype(jnp.float32) * in_bag
+    # count channel = in-bag ROW indicator (GOSS amplification rides only
+    # on g/h in the reference, goss.hpp; counts stay true row counts)
+    cnt_row = (in_bag > 0).astype(jnp.float32)
 
     def hist_for_children(leaf_l, leaf_r, leaf_of_row):
-        """One fused pass: histograms for both children ((g,h,c) x (l,r))."""
-        in_l = (leaf_of_row == leaf_l).astype(jnp.float32) * in_bag
-        in_r = (leaf_of_row == leaf_r).astype(jnp.float32) * in_bag
-        vals = jnp.stack([g * in_l, h * in_l, in_l,
-                          g * in_r, h * in_r, in_r], axis=0)  # [6, N]
+        """One fused pass: histograms for both children ((g,h,c) x (l,r)).
+
+        g/h already carry the in_bag multiplier (out-of-bag rows are 0, GOSS
+        rows amplified ONCE) — the leaf masks must stay plain indicators or
+        the amplification would square."""
+        ind_l = (leaf_of_row == leaf_l).astype(jnp.float32)
+        ind_r = (leaf_of_row == leaf_r).astype(jnp.float32)
+        vals = jnp.stack([g * ind_l, h * ind_l, cnt_row * ind_l,
+                          g * ind_r, h * ind_r, cnt_row * ind_r],
+                         axis=0)                                 # [6, N]
         hist6 = build_histogram(X_t, vals, B, cfg.rows_per_chunk)
         hist6 = psum(hist6)
         return hist6[:3], hist6[3:]
@@ -261,13 +269,12 @@ def grow_tree(
     # ---- root (BeforeTrain: serial_tree_learner.cpp:292-342)
     root_g = psum(jnp.sum(g))
     root_h = psum(jnp.sum(h))
-    root_c = psum(jnp.sum(in_bag))
+    root_c = psum(jnp.sum(cnt_row))
     root_out = jnp.asarray(
         -jnp.sign(root_g) * jnp.maximum(jnp.abs(root_g) - hp.lambda_l1, 0.0)
         / (root_h + hp.lambda_l2), jnp.float32)
 
-    in_root = in_bag
-    vals0 = jnp.stack([g, h, in_root], axis=0)
+    vals0 = jnp.stack([g, h, cnt_row], axis=0)
     hist_root = psum(build_histogram(X_t, vals0, B, cfg.rows_per_chunk))
     root_split, root_is_cat, root_bitset = search(
         hist_root, root_g, root_h, root_c, root_out)

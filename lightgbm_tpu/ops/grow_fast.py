@@ -104,6 +104,9 @@ def grow_tree_fast(
 
     g = grad.astype(jnp.float32) * in_bag
     h = hess.astype(jnp.float32) * in_bag
+    # count channel = in-bag ROW indicator (GOSS amplification rides only
+    # on g/h in the reference, goss.hpp; counts stay true row counts)
+    cnt_row = (in_bag > 0).astype(jnp.float32)
 
     def search(hist, sum_g, sum_h, count, out):
         num = find_best_split(hist, sum_g, sum_h, count, out, meta, hp,
@@ -121,12 +124,12 @@ def grow_tree_fast(
     # ---- root
     root_g = psum(jnp.sum(g))
     root_h = psum(jnp.sum(h))
-    root_c = psum(jnp.sum(in_bag))
+    root_c = psum(jnp.sum(cnt_row))
     root_out = jnp.asarray(
         -jnp.sign(root_g) * jnp.maximum(jnp.abs(root_g) - hp.lambda_l1, 0.0)
         / (root_h + hp.lambda_l2), jnp.float32)
 
-    vals0 = jnp.stack([g, h, in_bag], axis=0)
+    vals0 = jnp.stack([g, h, cnt_row], axis=0)
     hist_root = psum(build_histogram(X_t, vals0, B, cfg.rows_per_chunk))
     root_split, root_is_cat, root_bitset = search(
         hist_root, root_g, root_h, root_c, root_out)
@@ -223,10 +226,11 @@ def grow_tree_fast(
             # smaller-ness is decided by the caller via left/right counts
             in_small = jnp.where(smaller_is_left, go_left, go_right)
             m = in_small.astype(jnp.float32) * in_bag[idx]
+            mc = in_small.astype(jnp.float32) * cnt_row[idx]
             Xg = jnp.take(X_t, idx, axis=1)                          # [F, S]
             vals = jnp.stack([grad[idx].astype(jnp.float32) * m,
                               hess[idx].astype(jnp.float32) * m,
-                              m], axis=0)
+                              mc], axis=0)
             hist_small = build_histogram(Xg, vals, B, cfg.rows_per_chunk)
             return order, n_left, hist_small
 

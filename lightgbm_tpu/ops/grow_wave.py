@@ -31,11 +31,10 @@ split mathematics, identical best-first (leaf-wise) order — into batched
 Order semantics by mode:
   * wave_exact=True: same priority-queue order as the serial growers
     (serial_tree_learner.cpp:222; argmax ties by index); only the schedule
-    of device work differs. Per-bin counts are synthesized from hessians
-    with the parent count/hessian ratio (the reference's own cnt_factor
-    approximation, feature_histogram.hpp:877), so min_data_in_leaf
-    decisions and count metadata are approximate where the serial growers
-    carry exact counts. Cost: ~O(priority-chain) waves.
+    of device work differs. Histograms carry an exact count channel
+    (the 0/1 in-bag indicator, exact in the bf16 contraction), so
+    min_data_in_leaf decisions and count metadata match the serial
+    growers exactly. Cost: ~O(priority-chain) waves.
   * wave_exact=False (default): each wave applies EVERY ready leaf whose
     gain >= wave_gain_slack * (best frontier gain), in gain order — a
     gain-prioritized batched frontier that approaches strict leaf-wise as
@@ -132,9 +131,9 @@ class _WaveState(NamedTuple):
     leaf_output: jnp.ndarray       # [L] f32
     leaf_sum_g: jnp.ndarray        # [L] f32
     leaf_sum_h: jnp.ndarray        # [L] f32
-    hist_cache: jnp.ndarray        # [L, 2, F, B] leaf's own histogram
-    #                                (f32, or exact int32 when quantized)
-    small_hist: jnp.ndarray        # [L, 2, F, B] pending smaller-child hist
+    hist_cache: jnp.ndarray        # [L, C*F*B] leaf's own histogram, FLAT
+    #                                (f32 C=3; exact int32 C=2 quantized)
+    small_hist: jnp.ndarray        # [L, C*F*B] pending smaller-child hist
     small_is_left: jnp.ndarray     # [L] bool: which child the above is
     ready: jnp.ndarray             # [L] bool: child hists + searches done
     leaf_min: jnp.ndarray          # [L] f32 monotone output lower bound
@@ -202,13 +201,14 @@ def grow_tree_wave(
     use_mega = (_use_pallas(X_t, B) and not cfg.bundled
                 and not cfg.has_categorical and X_t.shape[0] <= 32)
     if use_mega:
-        # the megakernel's [K, C, 32, B] f32 output block lives in VMEM
+        # the megakernel's [HB*C*K, 32*LO] f32 output block lives in VMEM
         # for the whole grid; bound K so it stays within scoped VMEM.
         # The kernel pads the bin axis to the lane-friendly width, so the
         # budget must use that padded size, not cfg.num_bins_padded.
         from .histogram_pallas import _compute_dims
         B_lane = _compute_dims(B)[0]
-        kcap = 4_500_000 // (2 * 32 * B_lane * 4)
+        C_stat = 2 if quant else 3
+        kcap = 3_400_000 // (C_stat * 32 * B_lane * 4)
         kcap = max(1 << (kcap.bit_length() - 1), 1) if kcap >= 1 else 1
         buckets = _wave_buckets(L, min(kcap, 128))
     else:
@@ -223,14 +223,24 @@ def grow_tree_wave(
 
     g = grad.astype(jnp.float32) * in_bag
     h = hess.astype(jnp.float32) * in_bag
+    # counts are IN-BAG ROW COUNTS (0/1), not the in_bag multiplier: GOSS
+    # amplification rides only on the gradients/hessians in the reference
+    # (goss.hpp — bag indices are plain row sets), and 0/1 values stay
+    # exact in the bf16 histogram contraction
+    cnt_row = (in_bag > 0).astype(jnp.float32)
     root_g = psum(jnp.sum(g))
     root_h = psum(jnp.sum(h))
-    root_c = psum(jnp.sum(in_bag))
+    root_c = psum(jnp.sum(cnt_row))
 
-    # Histograms carry (grad, hess) only — per-bin counts are synthesized
-    # from hessians with the parent's count/hessian ratio at search time,
-    # exactly the reference's cnt_factor approximation
-    # (FindBestThresholdSequentially, feature_histogram.hpp:877).
+    # Histograms carry (grad, hess, count) in float mode: the count
+    # channel accumulates the 0/1 in-bag indicator, which is exact in the
+    # bf16 contraction — min_data_in_leaf decisions and leaf_count
+    # metadata are exact, matching the serial growers (the reference only
+    # approximates counts when weights exist, feature_histogram.hpp:877).
+    # QUANTIZED mode carries (grad, hess) only and synthesizes counts from
+    # hessians with the parent count/hessian ratio — exactly the
+    # reference's int-histogram behavior (FindBestThresholdSequentiallyInt
+    # uses cnt_factor everywhere, feature_histogram.hpp:1077-1324).
     if quant:
         # GradientDiscretizer::DiscretizeGradients semantics
         # (gradient_discretizer.cpp:72-162): per-tree scales synced by max
@@ -255,14 +265,24 @@ def grow_tree_wave(
         vals0 = jnp.stack([g8, h8], axis=0)              # [2, N] int8
         ch_scale = jnp.stack([g_scale, h_scale])[:, None, None]
     else:
-        vals0 = jnp.stack([g, h], axis=0)                # [2, N] f32
+        vals0 = jnp.stack([g, h, cnt_row], axis=0)       # [3, N] f32
         ch_scale = None
+    C = vals0.shape[0]
 
-    def to_f32(hist2):
-        """Descale an int32 [2, F, B] histogram (no-op for f32 mode)."""
+    def to_f32(histc):
+        """Descale an int32 [C, F, B] histogram (no-op for f32 mode)."""
         if quant:
-            return hist2.astype(jnp.float32) * ch_scale
-        return hist2
+            return histc.astype(jnp.float32) * ch_scale
+        return histc
+
+    def with_counts(histc, count, sum_h):
+        """[C, F, B] descaled histogram -> [3, F, B] with a count channel
+        (quantized mode synthesizes counts via the reference's cnt_factor,
+        feature_histogram.hpp:1077; float mode already carries them)."""
+        if not quant:
+            return histc
+        cntf = count / jnp.maximum(sum_h, 1e-12)
+        return jnp.concatenate([histc, histc[1:2] * cntf], axis=0)
 
     has_mono = meta.monotone is not None
     has_inter = meta.inter_sets is not None
@@ -349,17 +369,17 @@ def grow_tree_wave(
             # (Dataset::ConstructHistograms offsets) and reconstruct each
             # feature's default bin as parent - sum(others)
             # (Dataset::FixHistogram, dataset.h:778)
-            flat = hist2.reshape(2, -1)
+            flat = hist2.reshape(C, -1)
             hist2 = jnp.take(flat, meta.bundle_expand, axis=1,
-                             mode="fill", fill_value=0).reshape(2, F, B)
+                             mode="fill", fill_value=0).reshape(C, F, B)
             hist2 = to_f32(hist2)
-            parent2 = jnp.stack([sum_g, sum_h])
-            miss = parent2[:, None] - jnp.sum(hist2, axis=-1)   # [2, F]
+            parent = jnp.stack(
+                [sum_g, sum_h, count.astype(jnp.float32)][:C])
+            miss = parent[:, None] - jnp.sum(hist2, axis=-1)    # [C, F]
             hist2 = hist2 + meta.bundle_mfb[None] * miss[:, :, None]
         else:
             hist2 = to_f32(hist2)
-        cntf = count / jnp.maximum(sum_h, 1e-12)
-        hist = jnp.concatenate([hist2, hist2[1:2] * cntf], axis=0)
+        hist = with_counts(hist2, count, sum_h)   # [3, F, B]
         fmask = (sets_to_fmask(sets_row, meta_use, fmask_use)
                  if has_inter else fmask_use)
         if fmask_dyn is not None:
@@ -456,9 +476,7 @@ def grow_tree_wave(
         """Split search over the AGGREGATED voted feature columns (exact
         for voted features: global histograms + global parent stats).
         Meta arrays arrive gathered per voted feature (dynamic)."""
-        hist2 = to_f32(hist2)
-        cntf = count / jnp.maximum(sum_h, 1e-12)
-        hist = jnp.concatenate([hist2, hist2[1:2] * cntf], axis=0)
+        hist = with_counts(to_f32(hist2), count, sum_h)   # [3, F, B]
         mv = FeatureMeta(
             num_bins=mv_nb, missing_type=mv_mt, default_bin=mv_db,
             is_categorical=jnp.zeros_like(mv_nb, bool),
@@ -530,6 +548,11 @@ def grow_tree_wave(
         hist_cache0 = hist_root_local
     else:
         hist_cache0 = hist_root
+    # caches live FLAT [L, C*F*B]: a 2D state array keeps XLA from picking
+    # a leaf-minor layout for the per-wave gather/scatter one-hot matmuls
+    # (profiled at ~29 ms/tree of pure relayout copies with 4D caches)
+    hshape = hist_cache0.shape
+    hist_cache0 = hist_cache0.reshape(-1)
 
     tree = DeviceTree(
         num_leaves=jnp.asarray(1, jnp.int32),
@@ -671,7 +694,8 @@ def grow_tree_wave(
     # _wave_kernel). Falls back to the portable path for CPU meshes,
     # bundled (EFB) storage, categorical splits, or wide feature counts.
     if use_mega:
-        from .histogram_pallas import wave_pass_pallas, N_BLK
+        from .histogram_pallas import (wave_pass_pallas,
+                                       wave_relabel_pallas, N_BLK)
         from ..utils import round_up
         F0 = X_t.shape[0]
         n_blk = N_BLK if N >= N_BLK else max(round_up(N, 256), 256)
@@ -680,6 +704,7 @@ def grow_tree_wave(
         X_mega = jnp.pad(X_t.astype(jnp.int8),
                          ((0, 32 - F0), (0, Np - N)))
         vals_mega = jnp.pad(vals0, ((0, 0), (0, Np - N)))
+        hist_dtype = jnp.int32 if quant else jnp.float32
 
         def make_mega_branch(K):
             def branch(args):
@@ -693,7 +718,15 @@ def grow_tree_wave(
                 return new_lor, hist
             return branch
 
-        mega_branches = [make_mega_branch(K) for K in buckets]
+        def relabel_only_branch(args):
+            # final wave of a tree: splits to apply, no candidates left —
+            # skip the histogram contraction entirely
+            lor, tbl16 = args
+            new_lor = wave_relabel_pallas(X_mega, vals_mega, lor, tbl16, B)
+            return new_lor, jnp.zeros((KMAX, C, F0, B), hist_dtype)
+
+        mega_branches = [relabel_only_branch] \
+            + [make_mega_branch(K) for K in buckets]
 
     # ---- serial ORDER simulation: each step touches only [L]-sized gain/
     # ready arrays (~10 tiny ops), so the 254-step sequential chain costs
@@ -772,9 +805,21 @@ def grow_tree_wave(
             rg, rl = jax.lax.top_k(ready_gain, KMAX)
             sel = (rg > 0.0) & (j_iota < budget)
             if cfg.wave_gain_slack > 0.0:
+                # the slack guard exists to keep late budget for
+                # higher-gain speculated children (strict leaf-wise would
+                # split those first) — while the leaf budget is plentiful,
+                # deferring a ready leaf only fragments waves: every split
+                # with positive gain will fit anyway. Engage the guard
+                # only under budget pressure.
                 npos = jnp.sum(sel).astype(jnp.int32)
                 guard = rg >= cfg.wave_gain_slack * jnp.max(keyed)
-                sel &= guard | (j_iota < (npos + 1) // 2)
+                if L < 64:
+                    # small trees: order quality dominates and waves are
+                    # cheap — keep the guard always on
+                    pressure = jnp.bool_(True)
+                else:
+                    pressure = 2 * npos >= budget
+                sel &= guard | (j_iota < (npos + 1) // 2) | ~pressure
             napp = jnp.sum(sel).astype(jnp.int32)
             app_leaf = jnp.where(sel, rl.astype(jnp.int32), -1)
         appv = j_iota < napp                                 # [K] bool
@@ -839,9 +884,10 @@ def grow_tree_wave(
         # children own-histograms from the speculative pass + subtraction.
         # One-hot matmul gathers/scatters: XLA's dynamic gather runs ~2GB/s
         # here, while these read/write the 22MB caches at HBM speed.
-        hsm = _onehot_gather(st.small_hist, drop_p)          # [K, 2, F, B]
+        # Caches are flat [L, C*F*B] (see hist_cache0).
+        hsm = _onehot_gather(st.small_hist, drop_p)          # [K, C*F*B]
         hlg = _onehot_gather(st.hist_cache, drop_p) - hsm
-        sil = st.small_is_left[p_j][:, None, None, None]
+        sil = st.small_is_left[p_j][:, None]
         hcl = jnp.where(sil, hsm, hlg)
         hcr = jnp.where(sil, hlg, hsm)
         hist_cache = _onehot_scatter(
@@ -901,14 +947,18 @@ def grow_tree_wave(
         cand = cand.astype(jnp.int32)
         valid = (gains > 0.0) & (j_iota < budget2)
         if not cfg.wave_exact and cfg.wave_gain_slack > 0.0:
-            # mirror the apply guard: a leaf the apply rule would block
-            # anyway is not worth a histogram slot yet — it re-enters once
-            # the frontier's best gain drops to its level. Keeps the slot
-            # count paid per tree near the number of splits actually made
-            # (the apply-side guard is at wave_step's top).
+            # mirror the apply guard (incl. its budget-pressure gate): a
+            # leaf the apply rule would block anyway is not worth a
+            # histogram slot yet — it re-enters once the frontier's best
+            # gain drops to its level. Keeps the slot count paid per tree
+            # near the number of splits actually made.
             nval = jnp.sum(valid).astype(jnp.int32)
             guard = gains >= cfg.wave_gain_slack * jnp.max(keyed2)
-            valid &= guard | (j_iota < (nval + 1) // 2)
+            if L < 64:
+                pressure2 = jnp.bool_(True)
+            else:
+                pressure2 = 2 * nval >= budget2
+            valid &= guard | (j_iota < (nval + 1) // 2) | ~pressure2
         n_cand = jnp.sum(valid).astype(jnp.int32)
         bs = SplitResult(*[x[cand] for x in st.best])
 
@@ -940,11 +990,19 @@ def grow_tree_wave(
                 jnp.full((KMAX,), nl0, jnp.int32),
             ])                                               # [16, KMAX]
             if KMAX < 128:
-                tbl16 = jnp.pad(tbl16, ((0, 0), (0, 128 - KMAX)))
-            kidx_m = jnp.minimum(
-                jnp.searchsorted(
-                    bucket_bounds, jnp.maximum(napp, n_cand)
-                ).astype(jnp.int32), len(buckets) - 1)
+                # pad entries must be INACTIVE: leaf id -1 (0 is a real
+                # leaf — the kernel applies every active table entry)
+                tbl16 = jnp.pad(tbl16, ((0, 0), (0, 128 - KMAX)),
+                                constant_values=-1)
+            # histogram width tracks the CANDIDATE count only (the apply
+            # side always walks all 128 table rows — cheap compares);
+            # branch 0 skips the contraction when nothing is speculated
+            kidx_m = jnp.where(
+                n_cand > 0,
+                1 + jnp.minimum(
+                    jnp.searchsorted(bucket_bounds, n_cand)
+                    .astype(jnp.int32), len(buckets) - 1),
+                0)
             leaf_of_row, hist_wave = jax.lax.switch(
                 kidx_m, mega_branches, (st.leaf_of_row, tbl16))
             st = st._replace(leaf_of_row=leaf_of_row)
@@ -995,7 +1053,8 @@ def grow_tree_wave(
             else:
                 hist_small = psum(hist_local)
             hist_parent = _onehot_gather(
-                st.hist_cache, jnp.where(valid, cand, L))    # [K, 2, F, B]
+                st.hist_cache, jnp.where(valid, cand, L)
+            ).reshape((KMAX,) + hshape)                      # [K, 3, F, B]
             hist_large = hist_parent - hist_small
             hist_l = jnp.where(smaller_is_left[:, None, None, None],
                                hist_small, hist_large)
@@ -1038,14 +1097,14 @@ def grow_tree_wave(
                 from .split import per_feature_best_gain
                 kv = cfg.voting_top_k
                 kv2 = min(2 * kv, F)
-                hist_f32 = to_f32(hist_lr)                # [2K, 2, F, B]
-                loc_g = jnp.sum(hist_f32[:, 0, 0, :], axis=-1)
-                loc_h = jnp.sum(hist_f32[:, 1, 0, :], axis=-1)
-                cnt_ratio = c_lr / jnp.maximum(sh_lr, 1e-12)
-                loc_c = loc_h * cnt_ratio
-                cntf3 = cnt_ratio[:, None, None, None]
-                hist3 = jnp.concatenate(
-                    [hist_f32, hist_f32[:, 1:2] * cntf3], axis=1)
+                hist_v = to_f32(hist_lr)                  # [2K, C, F, B]
+                loc_g = jnp.sum(hist_v[:, 0, 0, :], axis=-1)
+                loc_h = jnp.sum(hist_v[:, 1, 0, :], axis=-1)
+                if quant:
+                    loc_c = loc_h * (c_lr / jnp.maximum(sh_lr, 1e-12))
+                else:
+                    loc_c = jnp.sum(hist_v[:, 2, 0, :], axis=-1)
+                hist3 = jax.vmap(with_counts)(hist_v, c_lr, sh_lr)
                 if bynode:
                     fm_vote = (bn_masks if feature_mask is None
                                else bn_masks & feature_mask[None, :])
@@ -1139,7 +1198,8 @@ def grow_tree_wave(
 
             return st._replace(
                 small_hist=_onehot_scatter(
-                    st.small_hist, jnp.where(valid, cand, L), hist_small),
+                    st.small_hist, jnp.where(valid, cand, L),
+                    hist_small.reshape(KMAX, -1)),
                 small_is_left=scat(st.small_is_left, smaller_is_left),
                 ready=scat(st.ready, True),
                 bestl=SplitResult(*[scat(a, v[:KMAX])

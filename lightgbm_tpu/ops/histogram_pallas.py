@@ -13,29 +13,38 @@ equivalent play is:
     VMEM, never materialized to HBM) contracted against the value channels on
     the MXU: hist[c, b] += vals[c, r] * (bins[r] == b).
 
-Two kernels:
+Contraction layout (the round-3 redesign; the first version ran one skinny
+matmul per feature pair and re-laid the result into a [K, C, F, B] block,
+which measured ~13% MXU utilization): per row-block the kernel
 
-  build_histogram_pallas        one histogram set      -> [C, F, B]
-  build_histogram_slots_pallas  K sets in one pass     -> [K, C, F, B]
+  1. builds the slot mask ONE broadcast compare [K, R] and the weight
+     matrix W = vals (x) slot_onehot as a single [C*K, R] array,
+  2. builds a CONCATENATED one-hot for a chunk of features in VMEM scratch:
+     oh[f*LO + b, r] = (bin[f, r] == b), shape [Fc*LO, R],
+  3. runs ONE large matmul W @ oh^T -> [C*K, Fc*LO] per chunk and adds it
+     into the flat output block out[C*K, F*LO] — a perfectly lane-tiled
+     accumulate (no per-feature strided writes).
 
-The slots ("wave") kernel is the performance centerpiece. Cost model per
-row-feature: the per-feature one-hot compare (the VPU-bound part, ~2*LO
-element-ops) is paid ONCE per pass regardless of K, while each slot only
-adds rows to the W matrix fed to the MXU. Growing K children per pass
-(ops/grow_wave.py) therefore divides the dominant VPU cost by the wave size
-— this replaces the CUDA design's atomicAdd-on-index-list economy, which
-has no TPU equivalent (gathers cost as much as full rescans here).
+The [K, C, F, B] shape is restored OUTSIDE the kernel by one tiny reshape/
+transpose. Bins wider than 128 (B = 256) run HB = 2 passes with the high
+bin bit folded into the one-hot build; the output rows become [HB*C*K].
 
-Layouts chosen for the TPU tiling rules (last dim = 128 lanes):
-  X_t   [F_pad, N_pad]  int8   (F padded to 32 — int8 sublane tile)
-  vals  [C, N_pad]      f32    (channels-major so N is the lane dim)
-  out   [(K,) C, F_pad, B] f32 (B is the lane dim)
+Kernels:
+
+  build_histogram_slots_pallas  K histogram sets in one pass -> [K, C, F, B]
+  build_histogram_pallas        single set (K = 1 wrapper)    -> [C, F, B]
+  wave_pass_pallas              fused split-apply (row relabel) + candidate
+                                smaller-child membership + slot histogram
+  take_leaf_values_pallas       exact values[leaf_of_row] gather
 
 The MXU contraction runs in bfloat16 with float32 accumulation: one-hot
 entries are exact in bf16, gradient/hessian values round to 8 mantissa bits
 before the exact f32 accumulation (the same single-precision-histogram
 trade the reference's GPU learner makes, docs/GPU-Performance.rst; the
-count channel stays exact since its values are 0/1).
+count channel stays exact since its values are 0/1). int8 `vals` run the
+contraction as s8 x s8 -> s32 (the analog of the reference's discretized
+histogram kernels, cuda_histogram_constructor.cu:253-527) — exact integer
+accumulation.
 """
 
 from __future__ import annotations
@@ -69,66 +78,92 @@ def _compute_dims(num_bins: int):
     return B, LO, HB
 
 
-def _slot_hist_contract(x_ref, out_ref, W, *, K, C, B, LO, HB, acc_dtype,
-                        w_dtype):
-    """Shared slot-histogram contraction: accumulate the [K*C, R]
-    slot-masked values W against per-feature bin one-hots into
-    out_ref[K, C, F_blk, B]. B <= 64 fills only LO of the MXU's 128
-    output lanes, so G = 128/LO features are packed side by side per
-    contraction (full 128-lane output tiles)."""
-    R = x_ref.shape[1]
-    G = max(128 // LO, 1) if HB == 1 else 1
-    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (LO, R), 0)
+def _feat_chunk(F: int, LO: int, rows: int) -> int:
+    """Features per one-hot chunk: the [Fc*LO, R] bf16 scratch targets
+    ~4 MB and the [rows, Fc*LO] f32 output block ~3.3 MB; chunk starts
+    stay 128-lane aligned, and the chunk count is balanced so the last
+    chunk carries no dead features (28 features -> 2x14, not 16+12pad:
+    padded features cost real MXU MACs)."""
+    align = max(128 // LO, 1)
+    fc = max(1024 // LO, align)
+    while rows * fc * LO * 4 > 3_400_000 and fc > align:
+        fc //= 2
+    if F <= fc:
+        return _round_up(F, align)
+    n_chunks = -(-F // fc)
+    return _round_up(-(-F // n_chunks), align)
 
-    for f0 in range(0, x_ref.shape[0], G):
-        if HB == 1:
-            ohs = []
-            for g in range(min(G, x_ref.shape[0] - f0)):
-                # int8 storage sign-extends bins >= 128; mask to unsigned
-                bins_f = x_ref[f0 + g, :].astype(jnp.int32) & 0xFF
-                lo = bins_f & (LO - 1)
-                ohs.append((lo[None, :] == lo_iota).astype(w_dtype))
-            oh = ohs[0] if len(ohs) == 1 else jnp.concatenate(ohs, axis=0)
+
+def _accum_chunk(xx, W, out_ref, col0, *, C, K, LO, HB, quantized):
+    """Accumulate one feature-chunk's histogram: xx [Fc, R] i32 bins,
+    W [C*K, R]; adds into out_ref[hb*C*K:(hb+1)*C*K, col0 : col0+Fc*LO].
+
+    The concatenated one-hot is fed to the matmul as a VALUE (not via a
+    VMEM scratch ref): letting Mosaic schedule its materialization saves
+    the explicit scratch round-trip (~2.6 ms per full-data pass measured
+    on v5e)."""
+    Fc, R = xx.shape
+    w_dtype = jnp.int8 if quantized else jnp.bfloat16
+    acc = jnp.int32 if quantized else jnp.float32
+    iota3 = jax.lax.broadcasted_iota(jnp.int32, (Fc, LO, R), 1)
+    if HB == 1:
+        oh = (xx[:, None, :] == iota3).reshape(Fc * LO, R).astype(w_dtype)
+        part = jax.lax.dot_general(
+            W, oh, (((1,), (1,)), ((), ())),
+            preferred_element_type=acc)                 # [C*K, Fc*LO]
+        out_ref[:, col0:col0 + Fc * LO] += part
+    else:
+        lo = xx & (LO - 1)
+        hi = xx >> 7
+        for hb in range(HB):
+            oh = ((lo[:, None, :] == iota3)
+                  & (hi == hb)[:, None, :]).reshape(Fc * LO, R) \
+                .astype(w_dtype)
             part = jax.lax.dot_general(
                 W, oh, (((1,), (1,)), ((), ())),
-                preferred_element_type=acc_dtype)      # [K*C, G*LO]
-            for g in range(len(ohs)):
-                out_ref[:, :, f0 + g, :] += \
-                    part[:, g * LO:(g + 1) * LO].reshape(K, C, B)
-        else:
-            bins_f = x_ref[f0, :].astype(jnp.int32) & 0xFF
-            lo = bins_f & (LO - 1)
-            oh_lo = (lo[None, :] == lo_iota).astype(w_dtype)
-            hi = bins_f >> 7
-            for hb in range(HB):
-                Whb = jnp.where((hi == hb)[None, :], W, 0)
-                part = jax.lax.dot_general(
-                    Whb, oh_lo, (((1,), (1,)), ((), ())),
-                    preferred_element_type=acc_dtype)
-                out_ref[:, :, f0, hb * LO:(hb + 1) * LO] += \
-                    part.reshape(K, C, LO)
+                preferred_element_type=acc)
+            out_ref[hb * C * K:(hb + 1) * C * K, col0:col0 + Fc * LO] += part
 
 
-def _slot_mask_W(vals, sl, K, w_dtype):
-    """[K*C, R] slot-masked value channels (shared across all features)."""
-    w_rows = []
-    for k in range(K):
-        w_rows.append(jnp.where((sl == k)[None, :], vals, 0))
-    return jnp.concatenate(w_rows, axis=0).astype(w_dtype)
+def _make_W(v, oh_slot, C, K, quantized):
+    """[C*K, R] channel-major weights: W[c*K + k, r] = vals[c, r] when
+    slot r == k else 0. One broadcast multiply/select — no per-slot loop."""
+    R = v.shape[1]
+    if quantized:
+        # v5e Mosaic has no int8 vector select — mask in i32, then narrow
+        W = jnp.where(oh_slot[None, :, :],
+                      v.astype(jnp.int32)[:, None, :], 0).astype(jnp.int8)
+    else:
+        W = oh_slot[None, :, :].astype(jnp.bfloat16) \
+            * v.astype(jnp.bfloat16)[:, None, :]
+    return W.reshape(C * K, R)
 
 
-def _slots_kernel(x_ref, v_ref, s_ref, out_ref, *, K, C, B, LO, HB,
-                  quantized):
-    """Grid (F_blocks, N_blocks); N varies fastest so out_ref stays resident.
+def _hist_chunks(xx_all, W, out_ref, Fc, *, C, K, LO, HB,
+                 quantized):
+    """Walk the block's features in exact chunks of Fc, accumulating into
+    out_ref. Chunks past the real feature count are padded with bin -1
+    (never one-hot-matched), so padded output columns stay zero."""
+    Fb = xx_all.shape[0]
+    Fh = out_ref.shape[1] // LO
+    for f0 in range(0, Fh, Fc):
+        xx = xx_all[f0:f0 + min(Fc, max(Fb - f0, 0)), :]
+        if xx.shape[0] < Fc:
+            xx = jnp.pad(xx, ((0, Fc - xx.shape[0]), (0, 0)),
+                         constant_values=-1)
+        _accum_chunk(xx, W, out_ref, f0 * LO, C=C, K=K, LO=LO,
+                     HB=HB, quantized=quantized)
 
-    x_ref  [F_BLK, R] int8          binned features
-    v_ref  [C, R]     f32 / int8    value channels (bag-masked)
-    s_ref  [1, R]     int32         slot id per row; outside [0, K) = none
-    out_ref[K, C, F_BLK, B] f32 / int32
 
-    quantized=True runs the contraction as s8 x s8 -> s32 on the MXU (the
-    int8 analog of the reference's discretized histogram kernels,
-    cuda_histogram_constructor.cu:253-527) — exact integer accumulation.
+def _slots_kernel(x_ref, v_ref, s_ref, out_ref, *, K, C, LO, HB,
+                  Fc, quantized):
+    """Grid (F_blocks, N_blocks); N varies fastest so out_ref stays
+    resident across the row sweep of each feature block.
+
+    x_ref  [Fb, R]  int8        binned features (this block)
+    v_ref  [C, R]   f32 / int8  value channels (bag-masked)
+    s_ref  [1, R]   int32       slot id per row; outside [0, K) = none
+    out_ref[HB*C*K, Fh*LO]      f32 / int32 (flat histogram block)
     """
     n = pl.program_id(1)
 
@@ -136,12 +171,21 @@ def _slots_kernel(x_ref, v_ref, s_ref, out_ref, *, K, C, B, LO, HB,
     def _():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    sl = s_ref[0, :]                                       # [R] i32
-    w_dtype = jnp.int8 if quantized else jnp.bfloat16
-    acc_dtype = jnp.int32 if quantized else jnp.float32
-    W = _slot_mask_W(v_ref[...], sl, K, w_dtype)           # [K*C, R]
-    _slot_hist_contract(x_ref, out_ref, W, K=K, C=C, B=B, LO=LO, HB=HB,
-                        acc_dtype=acc_dtype, w_dtype=w_dtype)
+    R = v_ref.shape[1]
+    iota_k = jax.lax.broadcasted_iota(jnp.int32, (K, R), 0)
+    oh_slot = s_ref[0:1, :] == iota_k                   # [K, R]
+    W = _make_W(v_ref[...], oh_slot, C, K, quantized)
+    xx_all = x_ref[...].astype(jnp.int32)
+    if HB > 1:
+        xx_all = xx_all & 0xFF
+    _hist_chunks(xx_all, W, out_ref, Fc, C=C, K=K, LO=LO, HB=HB,
+                 quantized=quantized)
+
+
+def _unflatten_hist(out, K, C, F, Fp, LO, HB, num_bins):
+    """[HB*C*K, Fp*LO] -> [K, C, F, num_bins]."""
+    h = out.reshape(HB, C, K, Fp, LO).transpose(2, 1, 3, 0, 4)
+    return h.reshape(K, C, Fp, HB * LO)[:, :, :F, :num_bins]
 
 
 @functools.partial(jax.jit,
@@ -161,13 +205,24 @@ def build_histogram_slots_pallas(
     K = num_slots
     quantized = vals.dtype == jnp.int8
     B, LO, HB = _compute_dims(num_bins)
-    # the [K, C, f_blk, B] f32 out block is double-buffered across the
-    # feature grid and must stay well inside scoped VMEM (16MB) next to the
-    # W/one-hot temporaries; shrink the feature block for wide waves
-    f_blk = F_BLK
-    while K * C * f_blk * B * 4 > 3_300_000 and f_blk > 8:
-        f_blk //= 2
-    Fp = _round_up(F, f_blk)
+    rows = HB * C * K
+    Fc_n = _feat_chunk(F, LO, rows)
+    if F <= 32 and rows * _round_up(F, Fc_n) * LO * 4 <= 3_400_000:
+        # narrow: one feature block holding ALL features (block == array
+        # dim satisfies the sublane-tiling rule without padding F), exact
+        # internal chunks — 28 features cost 28 features' MACs. Requires
+        # the whole [rows, F*LO] output block to fit the VMEM budget;
+        # wide waves at wide bins (e.g. K=128, C=3, B=256) fall through
+        # to the gridded path below.
+        Fc = Fc_n
+        Fb, Fp = F, F
+        Fh = _round_up(F, Fc)
+    else:
+        # wide: grid over 8-aligned feature blocks (block histograms
+        # stream through VMEM one block at a time)
+        Fc = max(_feat_chunk(F, LO, rows) // 8 * 8, 8)
+        Fb, Fh = Fc, Fc
+        Fp = _round_up(F, Fc)
     n_blk = N_BLK if N >= N_BLK else max(_round_up(N, 256), 256)
     Np = _round_up(N, n_blk)
 
@@ -181,32 +236,34 @@ def build_histogram_slots_pallas(
         s = jnp.pad(s, (0, Np - N), constant_values=-1)
 
     out_dtype = jnp.int32 if quantized else jnp.float32
-    grid = (Fp // f_blk, Np // n_blk)
-    kernel = functools.partial(_slots_kernel, K=K, C=C, B=B, LO=LO, HB=HB,
-                               quantized=quantized)
+    n_fblocks = Fp // Fb
+    out_cols = n_fblocks * Fh * LO
+    grid = (n_fblocks, Np // n_blk)
+    kernel = functools.partial(_slots_kernel, K=K, C=C, LO=LO, HB=HB,
+                               Fc=Fc, quantized=quantized)
     out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((f_blk, n_blk), lambda f, n: (f, n),
+            pl.BlockSpec((Fb, n_blk), lambda f, n: (f, n),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((C, n_blk), lambda f, n: (0, n),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, n_blk), lambda f, n: (0, n),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((K, C, f_blk, B), lambda f, n: (0, 0, f, 0),
+        out_specs=pl.BlockSpec((rows, Fh * LO), lambda f, n: (0, f),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((K, C, Fp, B), out_dtype),
+        out_shape=jax.ShapeDtypeStruct((rows, out_cols), out_dtype),
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
-            flops=2 * K * C * Fp * Np * B,
-            bytes_accessed=Fp * Np + (C * 4 + 4) * Np + K * C * Fp * B * 4,
+            flops=2 * K * C * (out_cols // LO) * Np * B,
+            bytes_accessed=Fp * Np + (C * 4 + 4) * Np + rows * out_cols * 4,
             transcendentals=0,
         ),
     )(X, v, s[None, :])
 
-    return out[:, :, :F, :num_bins]
+    return _unflatten_hist(out, K, C, F, out_cols // LO, LO, HB, num_bins)
 
 
 def _leaf_values_kernel(lor_ref, val_ref, out_ref, *, Lp):
@@ -271,89 +328,148 @@ def take_leaf_values_pallas(
 # DataPartition::Split (data_partition.hpp:102) for the relabel and
 # Dataset::ConstructHistograms (dataset.h:745) for the histogram — one
 # kernel instead of the reference's three hot loops.
+#
+# The caller-facing wave table keeps the 16-row semantic layout below; the
+# wrapper packs each entry's value fields into ONE int32 so the in-kernel
+# per-row lookups are single masked reductions over a [K, R] leaf-match
+# mask instead of 8-value select chains:
+#   packed = feat | thr<<10 | default_left<<19 | miss_bin<<20
+#            | smaller_is_left<<29 | active<<30
+# where miss_bin pre-resolves the missing test (default_bin for
+# MissingType::Zero, num_bins-1 for NaN, unreachable 0x1FF for None).
 # ---------------------------------------------------------------------------
 
-# rows of the packed [T_ROWS, 128] i32 wave table
+# rows of the semantic [T_ROWS, 128] i32 wave table
 _T_APP_LEAF, _T_APP_FEAT, _T_APP_THR, _T_APP_DL, _T_APP_MT, _T_APP_DB, \
     _T_APP_NB, _T_CAND_LEAF, _T_CAND_FEAT, _T_CAND_THR, _T_CAND_DL, \
     _T_CAND_MT, _T_CAND_DB, _T_CAND_NB, _T_CAND_SIL, _T_NL0 = range(16)
 T_ROWS = 16
 _MT_ZERO = 1      # must match models/tree.py MISSING_ZERO
 _MT_NAN = 2       # must match models/tree.py MISSING_NAN
+_MISS_NONE = 0x1FF  # unreachable bin sentinel (cols are 8-bit)
+
+# packed wave-table entry bit layout (storage F <= 32, bins <= 256):
+#   feat 0:5 | thr 5:13 | dl 13:14 | miss_bin 14:23 | sil 23:24
+#   | valid 24:25 | slot 25:32
 
 
-def _wave_kernel(x_ref, v_ref, lor_ref, tbl_ref, newlor_ref, out_ref, *,
-                 K, C, B, LO, F, quantized):
+def _pack_wave_table(table: jnp.ndarray) -> jnp.ndarray:
+    """[T_ROWS, 128] semantic table -> [128, 8] i32 packed/transposed:
+    col 0 applied leaf id (-1 inactive), col 1 applied packed fields,
+    col 2 candidate leaf id, col 3 candidate packed fields."""
+    t = table.astype(jnp.int32)
+
+    def miss_bin(mt, db, nb):
+        return jnp.where(mt == _MT_ZERO, db,
+                         jnp.where(mt == _MT_NAN, nb - 1, _MISS_NONE))
+
+    slot = jnp.arange(128, dtype=jnp.int32)
+
+    def pack(leaf, feat, thr, dl, mb, sil):
+        p = ((feat & 31) | (thr << 5) | (dl << 13) | (mb << 14)
+             | (sil << 23) | (1 << 24) | (slot << 25))
+        return jnp.where(leaf >= 0, p, 0)
+
+    zero = jnp.zeros((128,), jnp.int32)
+    p_app = pack(t[_T_APP_LEAF], t[_T_APP_FEAT], t[_T_APP_THR],
+                 t[_T_APP_DL],
+                 miss_bin(t[_T_APP_MT], t[_T_APP_DB], t[_T_APP_NB]), zero)
+    p_cand = pack(t[_T_CAND_LEAF], t[_T_CAND_FEAT], t[_T_CAND_THR],
+                  t[_T_CAND_DL],
+                  miss_bin(t[_T_CAND_MT], t[_T_CAND_DB], t[_T_CAND_NB]),
+                  t[_T_CAND_SIL])
+    cols = [t[_T_APP_LEAF], p_app, t[_T_CAND_LEAF], p_cand,
+            zero, zero, zero, zero]
+    return jnp.stack(cols, axis=1)                        # [128, 8]
+
+
+def _masked_pick(m, col):
+    """Per-row table value: sum_k m[k, r] * col[k] — rows match at most
+    one table entry, so the masked sum IS the select."""
+    return jnp.sum(jnp.where(m, col, 0), axis=0)          # [R] i32
+
+
+def _wave_logic(x_ref, v_ref, lor_ref, tbl_ref, nl0_ref, newlor_ref, *,
+                K, C, F, HB, quantized, with_hist):
+    """Shared relabel + candidate-membership body. The APPLY side always
+    walks all 128 table rows (inactive rows have leaf -1 and never match
+    — [128, R] compares cost ~2 VPU ops/row-block, so there is nothing
+    to bucket), while the candidate side is bucketed to K because the
+    MXU contraction cost scales with it. Returns oh_small [K, R] (None
+    when with_hist=False)."""
+    R = lor_ref.shape[1]
+    xx_log = x_ref[0:F, :].astype(jnp.int32)               # [F, R]
+    if HB > 1:
+        xx_log = xx_log & 0xFF
+    iota_f = jax.lax.broadcasted_iota(jnp.int32, (F, R), 0)
+
+    def go_left(p):
+        feat = p & 31
+        thr = (p >> 5) & 0xFF
+        dl = (p >> 13) & 1
+        mb = (p >> 14) & 0x1FF
+        col = jnp.sum(jnp.where(feat[None, :] == iota_f, xx_log, 0),
+                      axis=0)                              # [R]
+        return jnp.where(col == mb, dl, (col <= thr).astype(jnp.int32))
+
+    # ---- applied splits: relabel rows of split leaves
+    lor = lor_ref[0, :]                                    # [R] i32
+    mA = lor[None, :] == tbl_ref[:, 0:1]                   # [128, R]
+    pA = _masked_pick(mA, tbl_ref[:, 1:2])
+    glA = go_left(pA)
+    nl0 = nl0_ref[0]
+    new_lor = jnp.where((((pA >> 24) & 1) == 1) & (glA == 0),
+                        nl0 + ((pA >> 25) & 127), lor)
+    newlor_ref[0, :] = new_lor
+    if not with_hist:
+        return None
+
+    # ---- candidate membership on the post-apply leaf
+    mC = new_lor[None, :] == tbl_ref[:K, 2:3]              # [K, R]
+    pC = _masked_pick(mC, tbl_ref[:K, 3:4])
+    glC = go_left(pC)
+    silC = (pC >> 23) & 1
+    in_small = (((pC >> 24) & 1) == 1) & (glC == silC)     # [R]
+    return mC & in_small[None, :]                          # [K, R]
+
+
+def _wave_relabel_kernel(x_ref, v_ref, lor_ref, tbl_ref, nl0_ref,
+                         newlor_ref, *, C, F, HB, quantized):
+    """Relabel-only wave (a tree's final wave has applied splits but no
+    candidates left — paying a full histogram pass there is pure waste)."""
+    _wave_logic(x_ref, v_ref, lor_ref, tbl_ref, nl0_ref, newlor_ref,
+                K=0, C=C, F=F, HB=HB, quantized=quantized, with_hist=False)
+
+
+def _wave_kernel(x_ref, v_ref, lor_ref, tbl_ref, nl0_ref, newlor_ref,
+                 out_ref, *, K, C, LO, HB, F, Fc, quantized):
     """Grid (N_blocks,). x_ref [F_pad, R]; v_ref [C, R]; lor_ref [1, R];
-    tbl_ref [T_ROWS, 128] i32; newlor_ref [1, R]; out_ref [K, C, F_pad, B]
-    (VMEM-resident across the whole grid)."""
+    tbl_ref [128, 8] i32 packed; nl0_ref [1] i32 in SMEM;
+    newlor_ref [1, R]; out_ref [HB*C*K, Fh*LO] (VMEM-resident across the
+    whole grid).
+
+    All per-row logic runs either on full [F, R] / [K, R] tiles or on a
+    handful of [1, R] ops — 1-sublane [1, R] chains are ~8x below VPU
+    width, so the per-feature column extraction is a masked [F, R]
+    reduction, and per-entry table values arrive as ONE packed int32 via
+    a masked [K, R] reduction."""
     n = pl.program_id(0)
 
     @pl.when(n == 0)
     def _():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    R = v_ref.shape[1]
-    lor = lor_ref[0, :]                                    # [R] i32
-    tbl = tbl_ref[...]                                     # [16, 128] i32
-    neg1 = jnp.full((R,), -1, jnp.int32)
-    zero = jnp.zeros((R,), jnp.int32)
+    oh_small = _wave_logic(x_ref, v_ref, lor_ref, tbl_ref, nl0_ref,
+                           newlor_ref, K=K, C=C, F=F, HB=HB,
+                           quantized=quantized, with_hist=True)
 
-    def chain(key, rows, k_hi):
-        """Map each row's `key` through the slot table: returns slot plus
-        one selected value per requested table row (compare-select chains;
-        [R]-wide, no gathers)."""
-        slot = neg1
-        outs = [zero] * len(rows)
-        for j in range(k_hi):
-            m = key == tbl[rows[0], j]
-            slot = jnp.where(m, j, slot)
-            for i, rsel in enumerate(rows[1:], start=1):
-                outs[i] = jnp.where(m, tbl[rsel, j], outs[i])
-        return slot, outs
-
-    # ---- applied splits: relabel rows of split leaves
-    slotA, aout = chain(
-        lor, [_T_APP_LEAF, _T_APP_FEAT, _T_APP_THR, _T_APP_DL,
-              _T_APP_MT, _T_APP_DB, _T_APP_NB], K)
-    featA, thrA, dlA, mtA, dbA, nbA = aout[1:]
-    featA = jnp.where(slotA >= 0, featA, -1)
-
-    colA = zero
-    for f in range(F):
-        binv = x_ref[f, :].astype(jnp.int32) & 0xFF
-        colA = jnp.where(featA == f, binv, colA)
-    missA = ((mtA == _MT_ZERO) & (colA == dbA)) | \
-            ((mtA == _MT_NAN) & (colA == nbA - 1))
-    # go-left flags stay i32: Mosaic cannot select between i1 vectors
-    glA = jnp.where(missA, dlA, (colA <= thrA).astype(jnp.int32))
-    inA = slotA >= 0
-    nl0 = tbl[_T_NL0, 0]
-    new_lor = jnp.where(inA & (glA == 0), nl0 + slotA, lor)
-    newlor_ref[0, :] = new_lor
-
-    # ---- candidate membership on the post-apply leaf
-    slotC, couts = chain(
-        new_lor, [_T_CAND_LEAF, _T_CAND_FEAT, _T_CAND_THR, _T_CAND_DL,
-                  _T_CAND_MT, _T_CAND_DB, _T_CAND_NB, _T_CAND_SIL], K)
-    featC, thrC, dlC, mtC, dbC, nbC, silC = couts[1:]
-    featC = jnp.where(slotC >= 0, featC, -1)
-    colC = zero
-    for f in range(F):
-        binv = x_ref[f, :].astype(jnp.int32) & 0xFF
-        colC = jnp.where(featC == f, binv, colC)
-    missC = ((mtC == _MT_ZERO) & (colC == dbC)) | \
-            ((mtC == _MT_NAN) & (colC == nbC - 1))
-    glC = jnp.where(missC, dlC, (colC <= thrC).astype(jnp.int32))
-    in_small = (slotC >= 0) & (glC == silC)
-    sl = jnp.where(in_small, slotC, -1)
-
-    # ---- slot histogram (shared contraction body)
-    w_dtype = jnp.int8 if quantized else jnp.bfloat16
-    acc_dtype = jnp.int32 if quantized else jnp.float32
-    W = _slot_mask_W(v_ref[...], sl, K, w_dtype)           # [K*C, R]
-    _slot_hist_contract(x_ref, out_ref, W, K=K, C=C, B=B, LO=LO,
-                        HB=B // LO, acc_dtype=acc_dtype, w_dtype=w_dtype)
+    # ---- slot histogram (shared contraction)
+    W = _make_W(v_ref[...], oh_small, C, K, quantized)
+    xx_all = x_ref[0:F, :].astype(jnp.int32)
+    if HB > 1:
+        xx_all = xx_all & 0xFF
+    _hist_chunks(xx_all, W, out_ref, Fc, C=C, K=K, LO=LO, HB=HB,
+                 quantized=quantized)
 
 
 @functools.partial(jax.jit,
@@ -362,7 +478,7 @@ def wave_pass_pallas(
     X_binned_t: jnp.ndarray,   # [F, N] int8/uint8 (feature-major, F <= 32)
     vals: jnp.ndarray,         # [C, N] f32 (bag-masked) or int8 (quantized)
     leaf_of_row: jnp.ndarray,  # [N] int32
-    table: jnp.ndarray,        # [T_ROWS, 128] int32 packed wave table
+    table: jnp.ndarray,        # [T_ROWS, 128] int32 semantic wave table
     num_slots: int,
     num_bins: int,
     interpret: bool = False,
@@ -380,6 +496,9 @@ def wave_pass_pallas(
     B, LO, HB = _compute_dims(num_bins)
     assert F <= 32, "wave megakernel requires F <= 32 storage columns"
     Fp = 32
+    rows = HB * C * K
+    Fc = _feat_chunk(F, LO, rows)
+    Fh = _round_up(F, Fc)
     n_blk = N_BLK if NX >= N_BLK else max(_round_up(NX, 256), 256)
     Np = _round_up(NX, n_blk)
 
@@ -392,11 +511,13 @@ def wave_pass_pallas(
     lor = leaf_of_row.astype(jnp.int32)
     if Np != N:
         lor = jnp.pad(lor, (0, Np - N), constant_values=-1)
+    tblp = _pack_wave_table(table)
+    nl0 = table[_T_NL0, 0:1].astype(jnp.int32)
 
     out_dtype = jnp.int32 if quantized else jnp.float32
     grid = (Np // n_blk,)
-    kernel = functools.partial(_wave_kernel, K=K, C=C, B=B, LO=LO, F=F,
-                               quantized=quantized)
+    kernel = functools.partial(_wave_kernel, K=K, C=C, LO=LO, HB=HB, F=F,
+                               Fc=Fc, quantized=quantized)
     newlor, out = pl.pallas_call(
         kernel,
         grid=grid,
@@ -407,28 +528,85 @@ def wave_pass_pallas(
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, n_blk), lambda n: (0, n),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((T_ROWS, 128), lambda n: (0, 0),
+            pl.BlockSpec((128, 8), lambda n: (0, 0),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, n_blk), lambda n: (0, n),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((K, C, Fp, B), lambda n: (0, 0, 0, 0),
+            pl.BlockSpec((rows, Fh * LO), lambda n: (0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((1, Np), jnp.int32),
-            jax.ShapeDtypeStruct((K, C, Fp, B), out_dtype),
+            jax.ShapeDtypeStruct((rows, Fh * LO), out_dtype),
         ],
         interpret=interpret,
         cost_estimate=pl.CostEstimate(
-            flops=2 * K * C * Fp * Np * B,
-            bytes_accessed=Fp * Np + (C * 4 + 8) * Np + K * C * Fp * B * 4,
+            flops=2 * K * C * Fh * Np * B,
+            bytes_accessed=Fp * Np + (C * 4 + 8) * Np + rows * Fh * LO * 4,
             transcendentals=0,
         ),
-    )(X, v, lor[None, :], table)
+    )(X, v, lor[None, :], tblp, nl0)
 
-    return newlor[0, :N], out[:, :, :F, :num_bins]
+    hist = _unflatten_hist(out, K, C, F, Fh, LO, HB, num_bins)
+    return newlor[0, :N], hist
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "interpret"))
+def wave_relabel_pallas(
+    X_binned_t: jnp.ndarray,   # [F, N] int8/uint8 (feature-major, F <= 32)
+    vals: jnp.ndarray,         # [C, N] (unused; kept for a uniform ABI)
+    leaf_of_row: jnp.ndarray,  # [N] int32
+    table: jnp.ndarray,        # [T_ROWS, 128] int32 semantic wave table
+    num_bins: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Split application only: returns new_leaf_of_row [N] i32. Used for
+    a tree's final wave (no candidates left to speculate). `vals` is only
+    consulted for its dtype — the kernel streams a [C, 128] stub instead
+    of DMAing the real value channels it never reads."""
+    F, NX = X_binned_t.shape
+    C = vals.shape[0]
+    N = leaf_of_row.shape[0]
+    quantized = vals.dtype == jnp.int8
+    B, LO, HB = _compute_dims(num_bins)
+    assert F <= 32
+    Fp = 32
+    n_blk = N_BLK if NX >= N_BLK else max(_round_up(NX, 256), 256)
+    Np = _round_up(NX, n_blk)
+    X = X_binned_t.astype(jnp.int8)
+    if Fp != F or Np != NX:
+        X = jnp.pad(X, ((0, Fp - F), (0, Np - NX)))
+    v = vals[:, :128]
+    lor = leaf_of_row.astype(jnp.int32)
+    if Np != N:
+        lor = jnp.pad(lor, (0, Np - N), constant_values=-1)
+    tblp = _pack_wave_table(table)
+    nl0 = table[_T_NL0, 0:1].astype(jnp.int32)
+    kernel = functools.partial(_wave_relabel_kernel, C=C, F=F, HB=HB,
+                               quantized=quantized)
+    newlor = pl.pallas_call(
+        kernel,
+        grid=(Np // n_blk,),
+        in_specs=[
+            pl.BlockSpec((Fp, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, 128), lambda n: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((128, 8), lambda n: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, n_blk), lambda n: (0, n),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, Np), jnp.int32),
+        interpret=interpret,
+    )(X, v, lor[None, :], tblp, nl0)
+    return newlor[0, :N]
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "interpret"))

@@ -1,0 +1,170 @@
+"""Round-3 kernel experiments: find the fast formulation of the wave
+histogram contraction on the real chip.
+
+Variants:
+  cur      current _slots_kernel (per-G-group matmuls, strided accumulate)
+  big      one concatenated one-hot [F*LO, R], single dot, flat accumulate
+  ohonly   one-hot build only (VPU floor), K=1 matmul to keep it live
+  bigXXXX  big with n_blk = XXXX
+"""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from lightgbm_tpu.utils import round_up as _round_up
+
+N = 4_000_000
+F = 28
+NBINS = 63
+
+
+def _barrier(out):
+    leaves = jax.tree.leaves(out)
+    jax.device_get(jnp.sum(leaves[0].astype(jnp.float32).ravel()[:16]))
+
+
+def timeit(fn, *args, reps=10):
+    out = fn(*args)
+    _barrier(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    _barrier(out)
+    t_many = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = fn(*args)
+    _barrier(out)
+    t_one = time.perf_counter() - t0
+    return (t_many - t_one) / (reps - 1)
+
+
+# --------------------------------------------------------------------------
+# big-matmul variant: oh_all [F*LO, R] built in scratch, one dot per block,
+# accumulate into out_ref [K*C, F*LO] (flat, perfectly tiled).
+# --------------------------------------------------------------------------
+
+def _big_kernel(x_ref, v_ref, s_ref, out_ref, oh_ref, *, K, C, LO, F,
+                ohonly):
+    n = pl.program_id(0)
+
+    @pl.when(n == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    R = v_ref.shape[1]
+    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (LO, R), 0)
+    for f in range(F):
+        bins_f = x_ref[f, :].astype(jnp.int32)
+        oh_ref[f * LO:(f + 1) * LO, :] = \
+            (bins_f[None, :] == lo_iota).astype(jnp.bfloat16)
+
+    sl = s_ref[0, :]
+    if ohonly:
+        W = v_ref[0:1, :].astype(jnp.bfloat16)
+        part = jax.lax.dot_general(
+            W, oh_ref[...], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out_ref[0:1, :] += part
+        return
+    w_rows = []
+    for k in range(K):
+        w_rows.append(jnp.where((sl == k)[None, :], v_ref[...], 0))
+    W = jnp.concatenate(w_rows, axis=0).astype(jnp.bfloat16)  # [K*C, R]
+    part = jax.lax.dot_general(
+        W, oh_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                   # [K*C, F*LO]
+    out_ref[...] += part
+
+
+@functools.partial(jax.jit, static_argnames=("K", "n_blk", "ohonly"))
+def big_hist(X, vals, slot, K, n_blk, ohonly=False):
+    Fx, Nx = X.shape
+    C = vals.shape[0]
+    LO = 64
+    Np = _round_up(Nx, n_blk)
+    X = jnp.pad(X, ((0, 0), (0, Np - Nx)))
+    v = jnp.pad(vals, ((0, 0), (0, Np - Nx)))
+    s = jnp.pad(slot, (0, Np - Nx), constant_values=-1)
+    out = pl.pallas_call(
+        functools.partial(_big_kernel, K=K, C=C, LO=LO, F=Fx, ohonly=ohonly),
+        grid=(Np // n_blk,),
+        in_specs=[
+            pl.BlockSpec((Fx, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((K * C, Fx * LO), lambda n: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((K * C, Fx * LO), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((Fx * LO, n_blk), jnp.bfloat16)],
+    )(X, v, s[None, :])
+    return out
+
+
+def main():
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.randint(0, NBINS + 1, size=(F, N), dtype=np.int32)
+                    .astype(np.int8))
+    g = jnp.asarray(rng.normal(size=(N,)).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1.0, size=(N,)).astype(np.float32))
+    vals2 = jnp.stack([g, h])
+    vals3 = jnp.stack([g, h, jnp.ones_like(g)])
+    slot128 = jnp.asarray(rng.randint(0, 128, size=(N,), dtype=np.int32))
+
+    from lightgbm_tpu.ops.histogram_pallas import build_histogram_slots_pallas
+
+    for K in (1, 8, 32, 64, 128):
+        sl = jnp.minimum(slot128, K - 1)
+        t = timeit(functools.partial(build_histogram_slots_pallas,
+                                     num_slots=K, num_bins=NBINS),
+                   X, vals2, sl)
+        print(f"cur  C=2 K={K:3d} B=64:        {t*1e3:8.2f} ms")
+
+    t = timeit(functools.partial(big_hist, K=1, n_blk=2048, ohonly=True),
+               X, vals2, jnp.zeros((N,), jnp.int32))
+    print(f"ohonly n_blk=2048:           {t*1e3:8.2f} ms")
+
+    for n_blk in (1024, 2048, 4096):
+        for K in (1, 8, 32, 64, 128):
+            sl = jnp.minimum(slot128, K - 1)
+            try:
+                t = timeit(functools.partial(big_hist, K=K, n_blk=n_blk),
+                           X, vals2, sl)
+                print(f"big  C=2 K={K:3d} n_blk={n_blk}: {t*1e3:8.2f} ms")
+            except Exception as e:
+                print(f"big  C=2 K={K:3d} n_blk={n_blk}: FAIL "
+                      f"{str(e)[:80]}")
+                break
+
+    for K in (32, 128):
+        sl = jnp.minimum(slot128, K - 1)
+        try:
+            t = timeit(functools.partial(big_hist, K=K, n_blk=2048),
+                       X, vals3, sl)
+            print(f"big  C=3 K={K:3d} n_blk=2048: {t*1e3:8.2f} ms")
+        except Exception as e:
+            print(f"big  C=3 K={K:3d}: FAIL {str(e)[:80]}")
+
+    # correctness spot-check vs current kernel
+    K = 8
+    sl = jnp.minimum(slot128, K - 1)
+    ref = build_histogram_slots_pallas(X, vals2, sl, K, NBINS)
+    got = big_hist(X, vals2, sl, K, 2048).reshape(K, 2, F, 64)[..., :NBINS]
+    err = jnp.max(jnp.abs(ref - got))
+    print("max abs err big vs cur:", float(err))
+
+
+if __name__ == "__main__":
+    main()

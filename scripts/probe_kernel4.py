@@ -1,0 +1,76 @@
+"""Isolate the slots-kernel fixed cost: chunk count, C, K sweeps."""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = 4_000_000
+F = 28
+NBINS = 63
+
+
+def _barrier(out):
+    leaves = jax.tree.leaves(out)
+    jax.device_get(jnp.sum(leaves[0].astype(jnp.float32).ravel()[:16]))
+
+
+def timeit(fn, *args, reps=10, trials=5):
+    out = fn(*args)
+    _barrier(out)
+    est = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        _barrier(out)
+        t_many = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _barrier(out)
+        t_one = time.perf_counter() - t0
+        est.append((t_many - t_one) / (reps - 1))
+    return min(est)
+
+
+def main():
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.randint(0, NBINS + 1, size=(F, N), dtype=np.int32)
+                    .astype(np.int8))
+    g = jnp.asarray(rng.normal(size=(N,)).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1.0, size=(N,)).astype(np.float32))
+    vals2 = jnp.stack([g, h])
+    vals3 = jnp.stack([g, h, jnp.ones_like(g)])
+    slot128 = jnp.asarray(rng.randint(0, 128, size=(N,), dtype=np.int32))
+
+    import lightgbm_tpu.ops.histogram_pallas as hp
+
+    orig = hp._feat_chunk
+    for fc_override in (None, 28):
+        if fc_override is None:
+            hp._feat_chunk = orig
+        else:
+            hp._feat_chunk = lambda F_, LO, rows: fc_override
+        tag = f"fc={fc_override or 'auto'}"
+        for C, vals in ((2, vals2), (3, vals3)):
+            for K in (1, 8, 32, 64, 128):
+                sl = jnp.minimum(slot128, K - 1)
+                fn = functools.partial(
+                    hp.build_histogram_slots_pallas.__wrapped__,
+                    num_slots=K, num_bins=NBINS)
+                fn = jax.jit(fn, static_argnames=())
+                try:
+                    t = timeit(lambda: fn(X, vals, sl))
+                    print(f"slots {tag} C={C} K={K:3d}: {t*1e3:8.2f} ms")
+                except Exception as e:
+                    print(f"slots {tag} C={C} K={K:3d}: FAIL {str(e)[:70]}")
+    hp._feat_chunk = orig
+
+
+if __name__ == "__main__":
+    main()

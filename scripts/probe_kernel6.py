@@ -1,0 +1,192 @@
+"""One-hot build variants: scratch vs value-direct, i32 vs bf16 compare."""
+import functools
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from lightgbm_tpu.utils import round_up as _round_up
+from scripts.ktime import ktime
+
+N = 4_000_000
+F = 28
+LO = 64
+FC = 14
+
+
+def make_kernel(variant, K, C):
+    def kernel(x_ref, v_ref, s_ref, out_ref, oh_ref):
+        n = pl.program_id(0)
+
+        @pl.when(n == 0)
+        def _():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        R = v_ref.shape[1]
+        iota_k = jax.lax.broadcasted_iota(jnp.int32, (K, R), 0)
+        ohs = s_ref[0:1, :] == iota_k
+        W = (ohs[None, :, :].astype(jnp.bfloat16)
+             * v_ref[...].astype(jnp.bfloat16)[:, None, :]).reshape(C * K, R)
+        if variant in ("bf16", "bf16_direct"):
+            xx = x_ref[...].astype(jnp.bfloat16)
+            iota3 = jax.lax.broadcasted_iota(jnp.bfloat16, (FC, LO, R), 1)
+        else:
+            xx = x_ref[...].astype(jnp.int32)
+            iota3 = jax.lax.broadcasted_iota(jnp.int32, (FC, LO, R), 1)
+        for f0 in range(0, F, FC):
+            xs = xx[f0:f0 + FC]
+            cmp = (xs[:, None, :] == iota3) \
+                .reshape(FC * LO, R).astype(jnp.bfloat16)
+            if variant in ("direct", "bf16_direct"):
+                oh = cmp
+            else:
+                oh_ref[...] = cmp
+                oh = oh_ref[...]
+            part = jax.lax.dot_general(
+                W, oh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            out_ref[:, f0 * LO:(f0 + FC) * LO] += part
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("variant", "K"))
+def run(X, vals, slot, variant, K):
+    C = vals.shape[0]
+    n_blk = 2048
+    Np = _round_up(N, n_blk)
+    X = jnp.pad(X, ((0, 0), (0, Np - N)))
+    v = jnp.pad(vals, ((0, 0), (0, Np - N)))
+    s = jnp.pad(slot, (0, Np - N), constant_values=-1)
+    return pl.pallas_call(
+        make_kernel(variant, K, C),
+        grid=(Np // n_blk,),
+        in_specs=[
+            pl.BlockSpec((F, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((C * K, F * LO), lambda n: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((C * K, F * LO), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((FC * LO, n_blk), jnp.bfloat16)],
+    )(X, v, s[None, :])
+
+
+def main():
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.randint(0, 64, size=(F, N), dtype=np.int32)
+                    .astype(np.int8))
+    g = jnp.asarray(rng.normal(size=(N,)).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1.0, size=(N,)).astype(np.float32))
+    vals = jnp.stack([g, h, jnp.ones_like(g)])
+    slot128 = jnp.asarray(rng.randint(0, 128, size=(N,), dtype=np.int32))
+    ref = None
+    for variant in ("scratch", "direct", "bf16", "bf16_direct"):
+        for K in (1, 32, 128):
+            sl = jnp.minimum(slot128, K - 1)
+            try:
+                t, _ = ktime(lambda: run(X, vals, sl, variant, K))
+                got = run(X, vals, sl, variant, K)
+                if K == 1:
+                    if ref is None:
+                        ref = got
+                    err = float(jnp.max(jnp.abs(got - ref)))
+                else:
+                    err = -1.0
+                print(f"{variant:12s} K={K:3d}: {t:8.2f} ms  err={err}")
+            except Exception as e:
+                print(f"{variant:12s} K={K:3d}: FAIL {str(e)[:70]}")
+
+
+if __name__ == "__main__":
+    main()
+
+
+def make_kernel2(K, C, n_blk, swap):
+    FC2 = 14
+
+    def kernel(x_ref, v_ref, s_ref, out_ref):
+        n = pl.program_id(0)
+
+        @pl.when(n == 0)
+        def _():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        R = v_ref.shape[1]
+        iota_k = jax.lax.broadcasted_iota(jnp.int32, (K, R), 0)
+        ohs = s_ref[0:1, :] == iota_k
+        W = (ohs[None, :, :].astype(jnp.bfloat16)
+             * v_ref[...].astype(jnp.bfloat16)[:, None, :]).reshape(C * K, R)
+        xx = x_ref[...].astype(jnp.int32)
+        iota3 = jax.lax.broadcasted_iota(jnp.int32, (FC2, LO, R), 1)
+        for f0 in range(0, F, FC2):
+            xs = xx[f0:f0 + FC2]
+            oh = (xs[:, None, :] == iota3).reshape(FC2 * LO, R) \
+                .astype(jnp.bfloat16)
+            if swap:
+                part = jax.lax.dot_general(
+                    oh, W, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                out_ref[f0 * LO:(f0 + FC2) * LO, :] += part
+            else:
+                part = jax.lax.dot_general(
+                    W, oh, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                out_ref[:, f0 * LO:(f0 + FC2) * LO] += part
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("K", "n_blk", "swap"))
+def run2(X, vals, slot, K, n_blk, swap=False):
+    C = vals.shape[0]
+    Np = _round_up(N, n_blk)
+    X = jnp.pad(X, ((0, 0), (0, Np - N)))
+    v = jnp.pad(vals, ((0, 0), (0, Np - N)))
+    s = jnp.pad(slot, (0, Np - N), constant_values=-1)
+    oshape = (F * LO, C * K) if swap else (C * K, F * LO)
+    oblock = oshape
+    return pl.pallas_call(
+        make_kernel2(K, C, n_blk, swap),
+        grid=(Np // n_blk,),
+        in_specs=[
+            pl.BlockSpec((F, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(oblock, lambda n: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(oshape, jnp.float32),
+    )(X, v, s[None, :])
+
+
+def main2():
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.randint(0, 64, size=(F, N), dtype=np.int32)
+                    .astype(np.int8))
+    g = jnp.asarray(rng.normal(size=(N,)).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1.0, size=(N,)).astype(np.float32))
+    vals = jnp.stack([g, h, jnp.ones_like(g)])
+    slot128 = jnp.asarray(rng.randint(0, 128, size=(N,), dtype=np.int32))
+    for swap in (False, True):
+        for n_blk in (2048, 4096):
+            for K in (32, 64, 128):
+                sl = jnp.minimum(slot128, K - 1)
+                try:
+                    t, _ = ktime(lambda: run2(X, vals, sl, K, n_blk, swap))
+                    print(f"swap={int(swap)} n_blk={n_blk} K={K:3d}: "
+                          f"{t:8.2f} ms")
+                except Exception as e:
+                    print(f"swap={int(swap)} n_blk={n_blk} K={K:3d}: FAIL "
+                          f"{str(e)[:60]}")

@@ -1,0 +1,77 @@
+"""Trace bench-shaped training and aggregate per-op device time.
+
+Usage: python scripts/profile_tree.py [rows] [iters] [max_bin]
+Prints the top device ops by total time across the traced iterations.
+"""
+import collections
+import glob
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+rows = int(sys.argv[1]) if len(sys.argv) > 1 else 4_000_000
+iters = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+max_bin = int(sys.argv[3]) if len(sys.argv) > 3 else 63
+
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(42)
+cols = int(os.environ.get("BENCH_COLS", "28"))
+X = rng.normal(size=(rows, cols)).astype(np.float32)
+w = rng.normal(size=cols)
+y = (X @ w + rng.normal(scale=0.5, size=rows) > 0).astype(np.float32)
+
+params = dict(objective="binary", num_leaves=255, max_bin=max_bin,
+              learning_rate=0.1, min_data_in_leaf=20, verbose=-1,
+              bagging_freq=0)
+ds = lgb.Dataset(X, label=y)
+booster = lgb.Booster(params=params, train_set=ds)
+booster.update_batch(4)
+jax.device_get(jnp.sum(booster._gbdt.scores))
+
+tmp = tempfile.mkdtemp(prefix="jaxprof_")
+t0 = time.perf_counter()
+jax.profiler.start_trace(tmp)
+booster.update_batch(iters)
+jax.device_get(jnp.sum(booster._gbdt.scores))
+jax.profiler.stop_trace()
+wall = time.perf_counter() - t0
+print(f"wall for {iters} iters: {wall*1e3:.1f} ms "
+      f"({wall/iters*1e3:.1f} ms/tree)")
+
+pbs = glob.glob(os.path.join(tmp, "**", "*.xplane.pb"), recursive=True)
+assert pbs, f"no xplane under {tmp}"
+from jax.profiler import ProfileData
+
+for pb in pbs:
+    pd = ProfileData.from_serialized_xspace(open(pb, "rb").read())
+    for plane in pd.planes:
+        if "TPU" not in plane.name and "Device" not in plane.name:
+            continue
+        agg = collections.Counter()
+        cnt = collections.Counter()
+        for line in plane.lines:
+            lname = line.name or ""
+            if "step" in lname.lower():
+                continue
+            for ev in line.events:
+                name = ev.name
+                dur = ev.duration_ns
+                agg[name] += dur
+                cnt[name] += 1
+        if not agg:
+            continue
+        total = sum(agg.values())
+        print(f"\n=== plane {plane.name}: total {total/1e6:.1f} ms over "
+              f"{iters} iters ===")
+        for name, ns in agg.most_common(40):
+            print(f"{ns/1e6/iters:9.2f} ms/iter  x{cnt[name]//iters:<5d} "
+                  f"{name[:100]}")

@@ -176,7 +176,7 @@ def test_wave_pass_matches_reference():
     nl0 = np.full(K, 12)
     tbl = [*app, *cand, sil, nl0]
     tbl_np = np.stack([np.asarray(t, np.int32) for t in tbl])
-    tbl16 = jnp.asarray(np.pad(tbl_np, ((0, 0), (0, 128 - K))))
+    tbl16 = jnp.asarray(np.pad(tbl_np, ((0, 0), (0, 128 - K)), constant_values=-1))
 
     ref_lor, ref_hist = _ref_wave_pass(X, vals, lor, tbl, K, B)
     got_lor, got_hist = wave_pass_pallas(
@@ -204,7 +204,7 @@ def test_wave_pass_quantized_int8_exact():
     nl0 = np.full(4, 6)
     tbl = [*app, *cand, sil, nl0]
     tbl_np = np.stack([np.asarray(t, np.int32) for t in tbl])
-    tbl16 = jnp.asarray(np.pad(tbl_np, ((0, 0), (0, 128 - K))))
+    tbl16 = jnp.asarray(np.pad(tbl_np, ((0, 0), (0, 128 - K)), constant_values=-1))
     ref_lor, ref_hist = _ref_wave_pass(X, vals.astype(np.int64), lor, tbl,
                                        K, B)
     got_lor, got_hist = wave_pass_pallas(
@@ -231,7 +231,7 @@ def test_wave_pass_prepadded_inputs():
             np.array([1, 0]), np.array([MT_NONE] * 2), np.zeros(2, int),
             np.full(2, B - 1), np.array([1, 0]), np.full(2, 4)]
     tbl_np = np.stack([np.asarray(t, np.int32) for t in tblr])
-    tbl16 = jnp.asarray(np.pad(tbl_np, ((0, 0), (0, 126))))
+    tbl16 = jnp.asarray(np.pad(tbl_np, ((0, 0), (0, 126)), constant_values=-1))
     lor_j = jnp.asarray(lor)
     got1 = wave_pass_pallas(jnp.asarray(X), jnp.asarray(vals), lor_j,
                             tbl16, K, B, interpret=True)
