@@ -69,20 +69,23 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     # train AUC over the 2x iters trained so far: guards against "fast but
-    # wrong" — a kernel change that hurt split quality would show up here
+    # wrong" — a kernel change that hurt split quality would show up here.
+    # Uses the framework's own tie-aware AUCMetric so the gate and the
+    # trainer's metric can never diverge.
+    from lightgbm_tpu.metrics import create_metric
+
     sub = slice(0, min(rows, 500_000))
     pred = np.asarray(booster._gbdt.scores[0][:rows][sub])
     lab = y[sub]
-    # tie-averaged ranks (plain argsort ranks would make the metric depend
-    # on the arbitrary order of tied predictions)
-    uniq, inv = np.unique(pred, return_inverse=True)
-    counts = np.bincount(inv)
-    ends = np.cumsum(counts)
-    mid = ends - (counts - 1) / 2.0
-    ranks = mid[inv]
-    npos = lab.sum()
-    auc = (ranks[lab > 0].sum() - npos * (npos + 1) / 2) \
-        / max(npos * (lab.size - npos), 1)
+
+    class _MD:
+        label = lab
+        weight = None
+        query_boundaries = None
+
+    m = create_metric("auc", booster._gbdt.config)
+    m.init(_MD(), lab.size)
+    auc = m.eval(pred, None)[0][1]
 
     row_iters_per_sec = rows * iters / dt
     print(json.dumps({

@@ -164,6 +164,16 @@ class Dataset:
                     self.data = None
                 return self
             from .data.loader import load_text_file
+            if cfg.two_round:
+                # the reference's two_round trades a second file pass for
+                # lower peak memory (dataset_loader.cpp); this loader
+                # streams through the native parser in one pass with no
+                # extra copy, so the flag changes nothing — say so
+                # instead of silently swallowing it
+                log_warning(
+                    "two_round is accepted for compatibility; the TPU "
+                    "loader is single-pass/streaming and results are "
+                    "identical")
             X, y, w, g, names = load_text_file(
                 path, has_header=cfg.header,
                 label_column=cfg.label_column,
@@ -272,13 +282,16 @@ class Dataset:
         if self.reference is not None:
             self.reference.construct()
             ref_handle = self.reference._handle
+        # _to_1d_numpy (not plain asarray): pyarrow metadata arrays must
+        # work on the Sequence path exactly like on the matrix path
         self._handle = construct_from_sequences(
             seqs, cfg,
-            label=None if self.label is None else np.asarray(self.label),
-            weight=None if self.weight is None else np.asarray(self.weight),
-            group=None if self.group is None else np.asarray(self.group),
+            label=None if self.label is None else _to_1d_numpy(self.label),
+            weight=(None if self.weight is None
+                    else _to_1d_numpy(self.weight)),
+            group=None if self.group is None else _to_1d_numpy(self.group),
             init_score=(None if self.init_score is None
-                        else np.asarray(self.init_score)),
+                        else _to_1d_numpy(self.init_score)),
             categorical_feature=self._cat_indices(feature_names),
             feature_names=feature_names, reference=ref_handle)
         if self.free_raw_data:
@@ -408,7 +421,9 @@ class Dataset:
         (reference: basic.py Dataset.add_features_from ->
         Dataset::AddFeaturesFrom, dataset.h:971). Both sides must be
         constructed with the same row count; `other`'s bin mappers ride
-        along, EFB bundles are dropped (re-bundled on next use)."""
+        along. EFB bundles are dropped and NOT rebuilt (bundling happens
+        only at construction/binary load), so the merged dataset trains
+        unbundled — correct results, without EFB's storage savings."""
         self.construct()
         other.construct()
         h, o = self._handle, other._handle

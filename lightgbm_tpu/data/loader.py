@@ -10,11 +10,16 @@ this module is the portable fallback and the semantics reference.
 from __future__ import annotations
 
 import os
+import re
 from typing import List, Optional, Tuple
 
 import numpy as np
 
 from ..utils.log import log_fatal, log_info
+
+# leading-float matcher for the prefix-permissive fallback parser
+_FLOAT_PREFIX = re.compile(
+    r"^[+-]?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?")
 
 
 def _detect_format(first_lines: List[str]) -> str:
@@ -81,7 +86,8 @@ def load_text_file(path: str, has_header: bool = False,
                         else header_line.split())
 
     if fmt == "libsvm":
-        lines = [ln for ln in raw.decode().splitlines() if ln.strip()]
+        lines = [ln for ln in raw.decode(errors="replace").splitlines()
+                 if ln.strip()]
         return _load_libsvm(lines)
 
     sep = "," if fmt == "csv" else "\t"
@@ -91,7 +97,8 @@ def load_text_file(path: str, has_header: bool = False,
     from ..native import parse_text
     data = parse_text(raw, sep)
     if data is None:
-        lines = [ln for ln in raw.decode().splitlines() if ln.strip()]
+        lines = [ln for ln in raw.decode(errors="replace").splitlines()
+                 if ln.strip()]
         rows = [ln.split(sep) for ln in lines]
         ncol = max(len(r) for r in rows)
         data = np.full((len(rows), ncol), np.nan, dtype=np.float64)
@@ -104,9 +111,13 @@ def load_text_file(path: str, has_header: bool = False,
                 try:
                     data[i, j] = float(tok)
                 except ValueError:
-                    # permissive like the native strtod path and the
-                    # reference's Common::Atof: unparseable -> NaN
-                    pass
+                    # prefix-parse like the native strtod path and the
+                    # reference's Common::Atof ('1.5x' -> 1.5), so the
+                    # same file loads identically with or without the
+                    # C++ toolchain; fully unparseable -> NaN
+                    m = _FLOAT_PREFIX.match(tok)
+                    if m:
+                        data[i, j] = float(m.group(0))
     ncol = data.shape[1]
 
     label_idx = _parse_column_spec(label_column, header_names) \

@@ -292,6 +292,8 @@ class GBDT:
             voting_top_k=(cfg.top_k if cfg.tree_learner == "voting"
                           and self.use_dist else 0),
             feature_fraction_bynode=float(cfg.feature_fraction_bynode),
+            extra_trees=bool(cfg.extra_trees),
+            extra_seed=int(cfg.extra_seed),
         )
 
         # grower selection: "wave" (default via auto) applies batched
@@ -344,11 +346,12 @@ class GBDT:
         if (self.meta.monotone is not None
                 or self.meta.inter_sets is not None
                 or self.meta.forced is not None
-                or cfg.feature_fraction_bynode < 1.0) \
+                or cfg.feature_fraction_bynode < 1.0
+                or cfg.extra_trees) \
                 and self.grower not in ("wave", "wave_exact"):
             log_warning("monotone/interaction/forced-split/by-node-"
-                        "sampling features are implemented by the wave "
-                        "grower; switching tpu_grower to 'wave'")
+                        "sampling/extra_trees features are implemented by "
+                        "the wave grower; switching tpu_grower to 'wave'")
             self.grower = "wave"
         if cfg.tree_learner == "voting" and self.use_dist:
             if self.meta.forced is not None \

@@ -7,9 +7,9 @@ TPU-native formulation: instead of compacting `bag_data_indices_` index lists
 and copying Dataset subrows (CopySubrow, dataset.h:674), sampling produces a
 dense [N] multiplier vector: 0 for out-of-bag rows, 1 for in-bag, and
 (1-top_rate)/other_rate for GOSS-amplified rows. The grower multiplies
-grad/hess by it and carries it as the histogram count channel, which
-reproduces the reference's bagged counts and GOSS-amplified sufficient stats
-with static shapes.
+grad/hess by it; histogram COUNTS use only the 0/1 in-bag indicator
+(GOSS amplification rides on the gradients alone in the reference,
+goss.hpp — counts stay true row counts), all with static shapes.
 """
 
 from __future__ import annotations
@@ -58,6 +58,16 @@ class BaggingSampleStrategy(SampleStrategy):
             log_warning("pos/neg bagging needs labels; falling back to "
                         "uniform bagging")
             self._balanced = False
+        # bagging_by_query (bagging.hpp): the sampling unit is a whole
+        # query instead of a row
+        self._by_query = bool(config.bagging_by_query)
+        if self._by_query and metadata.query_boundaries is None:
+            from ..utils.log import log_fatal
+            log_fatal("bagging_by_query requires query/group information")
+        if self._by_query and self._balanced:
+            log_warning("bagging_by_query ignores pos/neg bagging "
+                        "fractions (query-level sampling)")
+            self._balanced = False
 
     def _need_resample(self, it: int) -> bool:
         freq = max(self.config.bagging_freq, 1)
@@ -72,6 +82,17 @@ class BaggingSampleStrategy(SampleStrategy):
         rng = np.random.RandomState(self.config.bagging_seed + it)
         N = self.num_data
         mask = np.zeros(N, dtype=np.float32)
+        if self._by_query:
+            qb = np.asarray(self.metadata.query_boundaries, np.int64)
+            nq = len(qb) - 1
+            keep = rng.choice(
+                nq, max(int(nq * self.config.bagging_fraction), 1),
+                replace=False)
+            keep_flags = np.zeros(nq, np.float32)
+            keep_flags[keep] = 1.0
+            mask = np.repeat(keep_flags, np.diff(qb))
+            self._cached = jnp.asarray(mask)
+            return self._cached
         if self._balanced:
             label = self.metadata.label
             pos = np.flatnonzero(label > 0)

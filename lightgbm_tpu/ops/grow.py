@@ -111,6 +111,13 @@ class GrowConfig(NamedTuple):
     # (seed, wave, child) so every shard draws the same mask
     feature_fraction_bynode: float = 1.0
 
+    # extra_trees (Config::extra_trees): every numerical-feature search
+    # considers ONE uniformly drawn threshold per feature
+    # (feature_histogram.hpp:203-207), keyed by (extra_seed, node) so
+    # shards agree
+    extra_trees: bool = False
+    extra_seed: int = 6
+
     @property
     def bundled(self) -> bool:
         return len(self.bundle_col) > 0

@@ -317,10 +317,10 @@ def grow_tree_wave(
     # are psum-aggregated for the (exact-on-voted-features) split search.
     vo = (dist is not None and cfg.n_shards > 1 and cfg.voting_top_k > 0
           and not cfg.bundled)
-    if vo and (has_forced or cfg.has_categorical):
+    if vo and (has_forced or cfg.has_categorical or cfg.extra_trees):
         raise NotImplementedError(
-            "tree_learner=voting does not support forced splits or "
-            "categorical features yet")
+            "tree_learner=voting does not support forced splits, "
+            "categorical features or extra_trees yet")
     fo = (dist is not None and cfg.n_shards > 1 and not cfg.bundled
           and not vo)
     nsh = cfg.n_shards
@@ -363,7 +363,8 @@ def grow_tree_wave(
 
     def make_search(meta_use, fmask_use, foffset=0):
       def search(hist2, sum_g, sum_h, count, out, bmin, bmax, sets_row,
-                 forced_id=None, used_f=None, fmask_dyn=None):
+                 forced_id=None, used_f=None, fmask_dyn=None,
+                 rand_dyn=None):
         if cfg.bundled:
             # EFB: re-slice the bundle histogram per ORIGINAL feature
             # (Dataset::ConstructHistograms offsets) and reconstruct each
@@ -390,11 +391,25 @@ def grow_tree_wave(
                     jnp.pad(fd, (0, F_use * nsh - fd.shape[0])),
                     foffset, F_use, 0)
             fmask = fd if fmask is None else (fmask & fd)
+        rand_b = None
+        if rand_dyn is not None:
+            F_use = int(meta_use.num_bins.shape[0])
+            rand_b = rand_dyn
+            if rand_b.shape[0] != F_use:  # sharded search: own slice
+                rand_b = jax.lax.dynamic_slice_in_dim(
+                    jnp.pad(rand_b, (0, F_use * nsh - rand_b.shape[0])),
+                    foffset, F_use, 0)
         pen = None
         if has_cegb and used_f is not None:
             # DeltaGain (cost_effective_gradient_boosting.hpp:81):
             # tradeoff * (penalty_split * leaf_count + coupled on first
-            # feature use)
+            # feature use). Documented divergence from the reference:
+            # UpdateLeafBestSplits (:96-117) re-searches OTHER leaves'
+            # cached splits when a feature first becomes used (their
+            # coupled penalty drops); here already-speculated leaves keep
+            # their penalized cached gains until their next natural
+            # re-search — a bounded approximation (at most one wave of
+            # staleness per feature first-use)
             F_use = int(meta_use.num_bins.shape[0])
             u = used_f
             if u.shape[0] != F_use:       # sharded search: own slice
@@ -418,13 +433,14 @@ def grow_tree_wave(
             num, fres = find_best_split_and_forced(
                 hist, sum_g, sum_h, count, out, meta_use, hp, fmask,
                 bmin if has_mono else None,
-                bmax if has_mono else None, ff, fb, cegb_pen=pen)
+                bmax if has_mono else None, ff, fb, cegb_pen=pen,
+                rand_bins=rand_b)
         else:
             num = find_best_split(hist, sum_g, sum_h, count, out,
                                   meta_use, hp, fmask,
                                   leaf_min=bmin if has_mono else None,
                                   leaf_max=bmax if has_mono else None,
-                                  cegb_pen=pen)
+                                  cegb_pen=pen, rand_bins=rand_b)
         nob = jnp.zeros((W,), jnp.uint32)
         if not cfg.has_categorical:
             merged, use_cat, bits = num, jnp.zeros((), bool), nob
@@ -469,6 +485,19 @@ def grow_tree_wave(
     if bynode:
         _bn_seed = rng_seed if rng_seed is not None else jnp.int32(0)
         _bn_base = jax.random.PRNGKey(_bn_seed + 0x5EED)
+
+    # extra_trees: one random threshold per (node, feature), keyed by
+    # replicated values so every shard draws identically
+    xt = cfg.extra_trees
+    if xt:
+        _xt_seed = rng_seed if rng_seed is not None else jnp.int32(0)
+        _xt_base = jax.random.PRNGKey(_xt_seed * 31 + cfg.extra_seed)
+
+    def xt_bins(key, n):
+        """[n, F] uniform thresholds in [0, max(num_bin-2, 1))."""
+        hi = jnp.maximum(meta.num_bins - 2, 1)
+        u = jax.random.uniform(key, (n, F))
+        return jnp.minimum((u * hi[None, :]).astype(jnp.int32), hi - 1)
 
     def search_voted(hist2, sum_g, sum_h, count, out, bmin, bmax,
                      sets_row, mv_nb, mv_mt, mv_db, mv_mono, mv_inter,
@@ -532,7 +561,9 @@ def grow_tree_wave(
         jnp.float32(-jnp.inf), jnp.float32(jnp.inf),
         jnp.ones((S,), bool), forced_id=root_fid, used_f=used0,
         fmask_dyn=(node_masks(jax.random.fold_in(_bn_base, 0), 1)[0]
-                   if bynode else None))
+                   if bynode else None),
+        rand_dyn=(xt_bins(jax.random.fold_in(_xt_base, 0), 1)[0]
+                  if xt else None))
     root_split = root_split._replace(
         gain=jnp.where(max_depth >= 1, root_split.gain, NEG_INF))
     root_forced &= max_depth >= 1
@@ -1152,12 +1183,18 @@ def grow_tree_wave(
                 s_lr = s_lr._replace(feature=jnp.take_along_axis(
                     vf, s_lr.feature[:, None], axis=1)[:, 0])
             else:
+                xt_rand = (xt_bins(
+                    jax.random.fold_in(_xt_base, st.tree.num_waves + 1),
+                    2 * KMAX) if xt else None)
                 s_lr, cat_lr, bits_lr, forced_lr = jax.vmap(
-                    lambda h_, sg_, sh_, c_, o_, bn_, bx_, st_, fi_, fd_:
+                    lambda h_, sg_, sh_, c_, o_, bn_, bx_, st_, fi_, fd_,
+                    rd_:
                     search_sh(h_, sg_, sh_, c_, o_, bn_, bx_, st_, fi_,
-                              used_f=st.feat_used, fmask_dyn=fd_))(
+                              used_f=st.feat_used, fmask_dyn=fd_,
+                              rand_dyn=rd_))(
                     hist_lr, sg_lr, sh_lr, c_lr, o_lr, bmin_lr, bmax_lr,
-                    sets_lr, fid_lr, bn_masks if bynode else None)
+                    sets_lr, fid_lr, bn_masks if bynode else None,
+                    xt_rand)
             if fo:
                 # map slice-local feature ids to global, then merge the
                 # per-shard bests by SELECTION KEY (a forced split must

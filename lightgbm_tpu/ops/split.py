@@ -237,6 +237,8 @@ def find_best_split(
     forced_f: jnp.ndarray | None = None,      # scalar i32: forced feature
     forced_b: jnp.ndarray | None = None,      # scalar i32: forced threshold
     cegb_pen: jnp.ndarray | None = None,      # [F] f32: CEGB gain penalty
+    rand_bins: jnp.ndarray | None = None,     # [F] i32: extra_trees random
+    #   threshold per feature — only this bin is considered
 ) -> SplitResult:
     """Best numerical split over all features for one leaf.
 
@@ -269,6 +271,13 @@ def find_best_split(
         gain = jnp.where(ok & restrict[None, :, :], gain, NEG_INF)
     else:
         gain = jnp.where(ok & (gain > min_gain_shift), gain, NEG_INF)
+    if rand_bins is not None:
+        # extra_trees (Config::extra_trees): each feature offers ONE
+        # uniformly drawn threshold per search (BeforeNumerical draws
+        # rand.NextInt(0, num_bin - 2), feature_histogram.hpp:203-207;
+        # the scan then skips every other threshold)
+        gain = jnp.where((bins == rand_bins[:, None])[None, :, :],
+                         gain, NEG_INF)
     if cegb_pen is not None:
         # CEGB: per-feature gain penalty subtracted AFTER each feature's
         # best-threshold scan, before the cross-feature argmax — the
@@ -326,6 +335,7 @@ def find_best_split_and_forced(
     leaf_min, leaf_max,
     forced_f: jnp.ndarray, forced_b: jnp.ndarray,
     cegb_pen: jnp.ndarray | None = None,
+    rand_bins: jnp.ndarray | None = None,
 ) -> tuple[SplitResult, SplitResult]:
     """Best numerical split AND the fixed forced-(feature, threshold)
     split from ONE gain-map computation (the map is the expensive part;
@@ -339,6 +349,11 @@ def find_best_split_and_forced(
     bins = jnp.arange(B, dtype=jnp.int32)[None, :]
     ok_n = ok if feature_mask is None else (ok & feature_mask[None, :, None])
     gain_n = jnp.where(ok_n & (gain > min_gain_shift), gain, NEG_INF)
+    if rand_bins is not None:
+        # extra_trees applies only to the NORMAL selection; a forced
+        # split keeps its fixed threshold
+        gain_n = jnp.where((bins == rand_bins[:, None])[None, :, :],
+                           gain_n, NEG_INF)
     if cegb_pen is not None:
         gain_n = jnp.where(jnp.isfinite(gain_n),
                            gain_n - cegb_pen[None, :, None], gain_n)

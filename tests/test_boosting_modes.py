@@ -76,3 +76,48 @@ def test_rf_requires_bagging():
     with pytest.raises(Exception):
         lgb.train({"objective": "binary", "boosting": "rf", "verbose": -1},
                   lgb.Dataset(X, y), num_boost_round=2)
+
+
+def test_extra_trees_trains_and_differs():
+    """extra_trees (Config::extra_trees, feature_histogram.hpp:203-207):
+    one random threshold per (node, feature). Trees must differ from the
+    exhaustive search but remain predictive."""
+    X, y = _binary()
+    base = dict(objective="binary", verbose=-1, num_leaves=31,
+                min_data_in_leaf=5)
+    bst = lgb.train({**base, "extra_trees": True}, lgb.Dataset(X, y),
+                    num_boost_round=30)
+    p = bst.predict(X)
+    assert np.mean((p > 0.5) == (y > 0.5)) > 0.85
+    ref = lgb.train(base, lgb.Dataset(X, y), num_boost_round=30)
+    # randomized thresholds must actually change the model
+    assert bst.model_to_string() != ref.model_to_string()
+    # and a different extra_seed draws different thresholds
+    bst2 = lgb.train({**base, "extra_trees": True, "extra_seed": 99},
+                     lgb.Dataset(X, y), num_boost_round=30)
+    assert bst.model_to_string() != bst2.model_to_string()
+
+
+def test_bagging_by_query_samples_whole_queries():
+    """bagging_by_query (bagging.hpp): the bagging unit is a query."""
+    from lightgbm_tpu.config import resolve_params
+    from lightgbm_tpu.models.sample_strategy import create_sample_strategy
+
+    rng = np.random.RandomState(0)
+    sizes = rng.randint(3, 9, size=40)
+    N = int(sizes.sum())
+
+    class MD:
+        label = None
+        query_boundaries = np.concatenate([[0], np.cumsum(sizes)])
+
+    cfg = resolve_params({"bagging_by_query": True, "bagging_freq": 1,
+                          "bagging_fraction": 0.5, "objective": "lambdarank"})
+    strat = create_sample_strategy(cfg, N, MD())
+    mask = np.asarray(strat.sample(0, None, None))
+    qb = MD.query_boundaries
+    per_query = [mask[qb[i]:qb[i + 1]] for i in range(len(sizes))]
+    # every query is fully in or fully out
+    assert all((q == q[0]).all() for q in per_query)
+    frac = np.mean([q[0] for q in per_query])
+    assert 0.3 < frac < 0.7
