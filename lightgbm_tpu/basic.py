@@ -883,7 +883,28 @@ class Booster:
                 new_val *= tree.shrinkage
                 tree.leaf_value = (decay_rate * tree.leaf_value
                                    + (1.0 - decay_rate) * new_val[:nl])
-                scores[k] += tree.leaf_value[leaf]
+                if getattr(tree, "is_linear", False):
+                    # reference: FitByExistingTree then
+                    # CalculateLinear(is_refit=true) with decay
+                    # (linear_tree_learner.cpp:139-156,330-390). The
+                    # saved model's per-leaf feature sets are reused
+                    # (tree->LeafFeatures), already numeric-filtered at
+                    # train time, expressed as raw column ids.
+                    from .models.linear import fit_linear_models
+                    Ftot = data.shape[1]
+                    out = fit_linear_models(
+                        tree, np.asarray(data, np.float32),
+                        leaf.astype(np.int32), grads[k], hesss[k],
+                        np.ones(N, np.float32),
+                        linear_lambda=float(cfg.linear_lambda),
+                        shrinkage=tree.shrinkage,
+                        numeric_inner=np.ones(Ftot, bool),
+                        inner_to_real=np.arange(Ftot, dtype=np.int64),
+                        leaf_features_inner=tree.leaf_features,
+                        is_refit=True, decay_rate=decay_rate)
+                    scores[k] += out
+                else:
+                    scores[k] += tree.leaf_value[leaf]
         return new_booster
 
     def dump_model_to_cpp(self) -> str:
@@ -891,6 +912,10 @@ class Booster:
         gbdt_model_text.cpp:262). Handles missing semantics (None/Zero/NaN
         per Tree::NumericalDecision, tree.h:375-407) and categorical bitset
         splits (Tree::CategoricalDecision)."""
+        if any(getattr(t, "is_linear", False) for t in self._gbdt.models):
+            from .utils.log import log_fatal
+            log_fatal("convert_model to C++ is not supported for linear "
+                      "trees")
         g = self._gbdt
         lines = ["#include <cmath>", "#include <cstdint>", "",
                  f"// generated by lightgbm_tpu; {len(g.models)} trees"]

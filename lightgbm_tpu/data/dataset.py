@@ -117,6 +117,10 @@ class BinnedDataset:
         self.bundle_col: Optional[List[int]] = None   # inner f -> column
         self.bundle_off: Optional[List[int]] = None   # inner f -> offset,
         #                                               -1 = raw singleton
+        # raw feature values, retained only when config.linear_tree needs
+        # them at fit time (reference keeps Dataset raw_data the same way,
+        # linear_tree_learner.cpp raw_index)
+        self.raw_data: Optional[np.ndarray] = None
 
     # -- derived per-feature arrays consumed by device kernels
     @property
@@ -272,6 +276,8 @@ def construct_from_matrix(
         col = np.asarray(data[:, orig], dtype=np.float64)
         X[:, inner] = m.value_to_bin(col).astype(X.dtype)
     ds.X_binned = X
+    if config.linear_tree:
+        ds.raw_data = np.ascontiguousarray(data, dtype=np.float32)
     return _finalize(ds, config, label, weight, group, init_score,
                      reference)
 

@@ -26,6 +26,11 @@ class DART(GBDT):
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
+        if getattr(self, "_linear", False):
+            from ..utils.log import log_fatal
+            log_fatal("boosting=dart with linear_tree is not supported "
+                      "yet: DART's drop/normalize score patching assumes "
+                      "constant leaf outputs")
         self._rng_drop = np.random.RandomState(self.config.drop_seed)
         self.tree_weight_: List[float] = []
         self.sum_weight_ = 0.0

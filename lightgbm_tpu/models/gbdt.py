@@ -365,11 +365,23 @@ class GBDT:
                 log_warning("tree_learner=voting is implemented by the "
                             "wave grower; switching tpu_grower to 'wave'")
                 self.grower = "wave"
-        # no silently-ignored parameters: fail loudly on parsed-but-
-        # unimplemented features (cf. VERDICT: silent drops are worse
-        # than absence)
-        if cfg.linear_tree:
-            log_fatal("linear_tree is not implemented in lightgbm_tpu yet")
+        # linear trees (reference: linear_tree_learner.cpp wrapping any
+        # single-node learner; the parallel learners refuse it there too)
+        self._linear = bool(cfg.linear_tree)
+        if self._linear:
+            if self.use_dist:
+                log_fatal("linear_tree is not supported with distributed "
+                          "tree learners (matches the reference)")
+            if ds.raw_data is None:
+                log_fatal(
+                    "linear_tree requires raw feature values at train "
+                    "time; construct the Dataset from an in-memory "
+                    "matrix or text file (binary caches, Sequences and "
+                    "sparse inputs do not retain raw data)")
+            self._raw = ds.raw_data
+            self._lin_numeric = ~ds.feature_is_categorical()
+            self._lin_inner2real = np.asarray(ds.real_feature_index,
+                                              np.int64)
         # CEGB (cost_effective_gradient_boosting.hpp): split + coupled
         # penalties implemented; the per-(row, feature) lazy penalty is not
         if cfg.cegb_penalty_feature_lazy:
@@ -650,6 +662,8 @@ class GBDT:
         window."""
         if type(self) is not GBDT:
             return False          # DART/RF override per-iter behavior
+        if self._linear:
+            return False          # per-tree host ridge fits
         if self.objective is None or self.objective.runs_on_host:
             return False
         if self.objective.need_renew_tree_output:
@@ -795,6 +809,14 @@ class GBDT:
                     and self.objective.need_renew_tree_output):
                 tree_dev, new_scores = self._renew_tree_output(
                     k, tree_dev, leaf_of_row, lr)
+            if self._linear:
+                # per-leaf ridge fits on the host (linear_tree_learner.cpp
+                # CalculateLinear); scores advance by the LINEAR outputs
+                bias = float(init_scores[k]) if self.iter == 0 else 0.0
+                self._fit_and_apply_linear(
+                    k, tree_dev, leaf_of_row, g_dev[k], h_dev[k],
+                    in_bag if in_bag.ndim == 1 else in_bag[k], bias)
+                continue
             self.scores = self.scores.at[k].set(new_scores)
             # valid scores update BEFORE the bias fold: scorers received the
             # init score separately in _boost_from_average (the reference
@@ -903,6 +925,48 @@ class GBDT:
         tree.threshold_in_bin = thr_bin
         tree.split_is_cat = is_cat
         tree.split_cat_bitset_bins = bits
+
+    def _fit_and_apply_linear(self, k: int, tree_dev, leaf_of_row,
+                              g_dev, h_dev, in_bag, bias: float) -> None:
+        """Linear-tree per-iteration host path: materialize the tree,
+        ridge-fit its leaves on raw branch features
+        (linear_tree_learner.cpp:183-345), advance training and valid
+        scores by the LINEAR outputs, and record the host tree."""
+        from .linear import fit_linear_models
+
+        host = jax.device_get(tree_dev)
+        tree = self._device_tree_to_host(host)
+        nd = self.num_data
+        lor = np.asarray(jax.device_get(leaf_of_row))[:nd]
+        g = np.asarray(jax.device_get(g_dev))[:nd]
+        h = np.asarray(jax.device_get(h_dev))[:nd]
+        bag = np.asarray(jax.device_get(in_bag))[:nd]
+        # materialize pending first so model order stays iteration-major
+        self._materialize_models()
+        is_first = len(self._models) < self.num_tree_per_iteration
+        delta = fit_linear_models(
+            tree, self._raw, lor, g, h, bag,
+            linear_lambda=float(self.config.linear_lambda),
+            shrinkage=self.shrinkage_rate,
+            numeric_inner=self._lin_numeric,
+            inner_to_real=self._lin_inner2real,
+            is_first_tree=is_first)
+        dd = np.asarray(delta, np.float32)
+        if self.N_pad != nd:
+            dd = np.pad(dd, (0, self.N_pad - nd))
+        self.scores = self.scores.at[k].set(
+            self.scores[k] + jnp.asarray(dd))
+        for vi in range(len(self.valid_sets)):
+            v_raw = self.valid_sets[vi].raw_data
+            if v_raw is None:
+                log_fatal("linear_tree validation requires raw data on "
+                          "the valid Dataset")
+            lin = np.asarray(tree.predict(v_raw), np.float32)
+            self._valid_scores[vi] = self._valid_scores[vi].at[k].set(
+                self._valid_scores[vi][k] + jnp.asarray(lin))
+        if abs(bias) > _KEPS:
+            tree.add_bias(bias)
+        self._models.append(tree)
 
     def _renew_tree_output(self, k: int, tree_dev, leaf_of_row, lr):
         """Leaf-output renewal for l1/quantile/mape: replace each leaf's

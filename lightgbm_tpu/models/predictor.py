@@ -83,6 +83,32 @@ class PackedModel:
                 word_off += len(t.cat_threshold)
         self.cat_boundaries = np.concatenate(cb)
         self.cat_threshold = np.concatenate(ct)
+        # linear leaves (tree.cpp AddPredictionToScore linear path): a
+        # uniform representation — non-linear trees get const=leaf_value
+        # with zero coefficients, so one ragged pass covers mixed models
+        self.has_linear = any(t.is_linear for t in trees)
+        if self.has_linear:
+            self.leaf_const = np.zeros(L, np.float64)
+            counts = np.zeros(L, np.int32)
+            feat_flat: List[int] = []
+            coef_flat: List[float] = []
+            for i, t in enumerate(trees):
+                la = self.leaf_start[i]
+                if t.is_linear:
+                    self.leaf_const[la:la + t.num_leaves] = t.leaf_const
+                    for li in range(t.num_leaves):
+                        cs = t.leaf_coeff[li]
+                        counts[la + li] = len(cs)
+                        feat_flat.extend(t.leaf_features[li])
+                        coef_flat.extend(cs)
+                else:
+                    self.leaf_const[la:la + t.num_leaves] = t.leaf_value
+            self.coef_count = counts
+            self.coef_start = np.zeros(L + 1, np.int64)
+            np.cumsum(counts, out=self.coef_start[1:])
+            self.coef_feat = np.asarray(feat_flat, np.int64)
+            self.coef_val = np.asarray(coef_flat, np.float64)
+            self.max_coeffs = int(counts.max()) if L else 0
 
     # ------------------------------------------------------------------
     def _step(self, X, rows, node, tsel):
@@ -139,7 +165,24 @@ class PackedModel:
                 break
             node = self._step(X, rows, node, tsel)
         leaf = ~node
-        return self.leaf_value[self.leaf_start[tsel][None, :] + leaf]
+        gl = self.leaf_start[tsel][None, :] + leaf
+        if not self.has_linear:
+            return self.leaf_value[gl]
+        # linear leaves: const + sum(coeff * raw); any NaN in a used
+        # feature falls back to the constant leaf_value (tree.cpp:144-152)
+        base = self.leaf_const[gl]
+        add = np.zeros_like(base)
+        nan_found = np.zeros(base.shape, bool)
+        nc = self.coef_count[gl]
+        for j in range(self.max_coeffs):
+            m = j < nc
+            idx = np.clip(self.coef_start[gl] + j, 0,
+                          max(len(self.coef_feat) - 1, 0))
+            f = self.coef_feat[idx] if len(self.coef_feat) else idx
+            v = X[rows[:, None], f].astype(np.float64)
+            nan_found |= m & np.isnan(v)
+            add += np.where(m, np.nan_to_num(v) * self.coef_val[idx], 0.0)
+        return np.where(nan_found, self.leaf_value[gl], base + add)
 
     # ------------------------------------------------------------------
     def predict_margin(

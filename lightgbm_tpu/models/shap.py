@@ -163,6 +163,10 @@ def _decide_children(tree, x: np.ndarray, node: int):
 def predict_contrib(gbdt, X: np.ndarray, start_iteration: int = 0,
                     num_iteration: int = -1) -> np.ndarray:
     """[N, (F+1) * K] SHAP values (+ expected value column per class)."""
+    if any(getattr(t, "is_linear", False) for t in gbdt.models):
+        from ..utils.log import log_fatal
+        log_fatal("pred_contrib (TreeSHAP) is not supported for "
+                  "linear trees")
     X = np.asarray(X, dtype=np.float64)
     N = X.shape[0]
     F = gbdt.max_feature_idx_ + 1

@@ -74,7 +74,12 @@ class Tree:
         self.internal_count = np.zeros(m, dtype=np.int64)
         self.cat_boundaries = np.zeros(1, dtype=np.int32)    # [num_cat + 1]
         self.cat_threshold = np.zeros(0, dtype=np.uint32)    # bitsets
+        # linear leaves (reference: tree.h leaf_const_/leaf_coeff_/
+        # leaf_features_; fit by models/linear.py)
         self.is_linear = False
+        self.leaf_const = np.zeros(n, dtype=np.float64)
+        self.leaf_features: List[List[int]] = [[] for _ in range(n)]
+        self.leaf_coeff: List[List[float]] = [[] for _ in range(n)]
         self.shrinkage = 1.0
 
     # ------------------------------------------------------------------
@@ -129,10 +134,31 @@ class Tree:
     # prediction (vectorized host path; device path lives in ops/predict.py)
     # ------------------------------------------------------------------
     def predict(self, X: np.ndarray) -> np.ndarray:
-        """Per-row leaf value (reference: Tree::Predict via GetLeaf,
-        tree.h:438)."""
+        """Per-row output (reference: Tree::Predict via GetLeaf,
+        tree.h:438; linear leaves follow the AddPredictionToScore linear
+        path, tree.cpp:130-155 — leaf_const + sum(coeff * raw), falling
+        back to the constant leaf_value when any used feature is NaN)."""
         leaf = self.get_leaf_index(X)
-        return self.leaf_value[leaf]
+        if not self.is_linear:
+            return self.leaf_value[leaf]
+        out = self.leaf_const[leaf].copy()
+        nan_found = np.zeros(X.shape[0], dtype=bool)
+        for li in range(self.num_leaves):
+            feats = self.leaf_features[li]
+            if not feats:
+                continue
+            rows = leaf == li
+            if not rows.any():
+                continue
+            vals = X[np.ix_(rows, feats)].astype(np.float64)
+            bad = np.isnan(vals).any(axis=1)
+            contrib = np.where(
+                bad[:, None], 0.0,
+                vals * np.asarray(self.leaf_coeff[li])[None, :]).sum(axis=1)
+            out[rows] += contrib
+            nan_idx = np.flatnonzero(rows)[bad]
+            nan_found[nan_idx] = True
+        return np.where(nan_found, self.leaf_value[leaf], out)
 
     def get_leaf_index(self, X: np.ndarray) -> np.ndarray:
         n_rows = X.shape[0]
@@ -236,15 +262,23 @@ class Tree:
         return np.maximum(out, 0)
 
     def shrink(self, rate: float) -> None:
-        """reference: Tree::Shrinkage (tree.h:189)."""
+        """reference: Tree::Shrinkage (tree.h:189) — linear constants
+        and coefficients scale with the leaf values."""
         self.leaf_value *= rate
         self.internal_value *= rate
+        if self.is_linear:
+            self.leaf_const *= rate
+            self.leaf_coeff = [[c * rate for c in cs]
+                               for cs in self.leaf_coeff]
         self.shrinkage *= rate
 
     def add_bias(self, val: float) -> None:
-        """reference: Tree::AddBias (tree.h:214)."""
+        """reference: Tree::AddBias (tree.h:214) — linear constants carry
+        the bias too (tree.h:225-229)."""
         self.leaf_value = self.leaf_value + val
         self.internal_value = self.internal_value + val
+        if self.is_linear:
+            self.leaf_const = self.leaf_const + val
         self.shrinkage = 1.0
 
     def expected_value(self) -> float:
@@ -296,6 +330,27 @@ class Tree:
             buf.append("cat_boundaries=" + _arr_to_str(self.cat_boundaries))
             buf.append("cat_threshold=" + _arr_to_str(self.cat_threshold))
         buf.append(f"is_linear={int(self.is_linear)}")
+        if self.is_linear:
+            # reference: tree.cpp ToString is_linear block (:382-410)
+            buf.append("leaf_const=" + _arr_to_str(
+                [float(v) for v in self.leaf_const[:n]],
+                high_precision=True))
+            buf.append("num_features=" + _arr_to_str(
+                [len(self.leaf_coeff[i]) for i in range(n)]))
+            lf = []
+            for i in range(n):
+                if self.leaf_coeff[i]:
+                    lf.append(_arr_to_str(self.leaf_features[i]) + " ")
+                lf.append(" ")
+            buf.append("leaf_features=" + "".join(lf).rstrip("\n"))
+            lc = []
+            for i in range(n):
+                if self.leaf_coeff[i]:
+                    lc.append(_arr_to_str(
+                        [float(c) for c in self.leaf_coeff[i]],
+                        high_precision=True) + " ")
+                lc.append(" ")
+            buf.append("leaf_coeff=" + "".join(lc))
         buf.append("shrinkage=" + _fmt(self.shrinkage))
         buf.append("")
         return "\n".join(buf) + "\n"
@@ -342,6 +397,21 @@ class Tree:
             # threshold column stores the cat_idx for categorical nodes
             t.threshold_in_bin = t.threshold.astype(np.int32)
         t.is_linear = bool(int(float(kv.get("is_linear", "0"))))
+        if t.is_linear:
+            t.leaf_const = geta("leaf_const", np.float64, n)
+            nf = geta("num_features", np.int64, n)
+            feat_toks = kv.get("leaf_features", "").split()
+            coef_toks = kv.get("leaf_coeff", "").split()
+            t.leaf_features, t.leaf_coeff = [], []
+            fpos = cpos = 0
+            for i in range(n):
+                k = int(nf[i]) if i < len(nf) else 0
+                t.leaf_features.append(
+                    [int(v) for v in feat_toks[fpos:fpos + k]])
+                t.leaf_coeff.append(
+                    [float(v) for v in coef_toks[cpos:cpos + k]])
+                fpos += k
+                cpos += k
         t.shrinkage = float(kv.get("shrinkage", "1"))
         return t
 

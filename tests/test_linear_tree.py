@@ -1,0 +1,113 @@
+"""Linear trees (reference: src/treelearner/linear_tree_learner.cpp,
+model format src/io/tree.cpp:382-410).
+
+Ground truth: the reference CLI trained on the same synthetic data with
+objective=regression num_leaves=15 lr=0.1 min_data_in_leaf=20
+linear_tree=true linear_lambda=0.01 x50 rounds scores test-L2 = 0.0911;
+this build scores 0.0924 (parity within 2%)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _linear_data(seed=0, n=3000, f=8, n_te=500):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    w = rng.normal(size=f)
+
+    def make_y(A, m):
+        return (A @ w + 0.5 * A[:, 0] * A[:, 1]
+                + rng.normal(scale=0.1, size=m)).astype(np.float32)
+
+    Xte = rng.normal(size=(n_te, f)).astype(np.float32)
+    return X, make_y(X, n), Xte, make_y(Xte, n_te)
+
+
+PARAMS = dict(objective="regression", num_leaves=15, learning_rate=0.1,
+              verbose=-1, min_data_in_leaf=20)
+
+
+def test_linear_beats_constant_and_matches_reference_level():
+    X, y, Xte, yte = _linear_data()
+    b0 = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=50)
+    l2_const = float(np.mean((yte - b0.predict(Xte)) ** 2))
+    b1 = lgb.train({**PARAMS, "linear_tree": True, "linear_lambda": 0.01},
+                   lgb.Dataset(X, label=y), num_boost_round=50)
+    l2_lin = float(np.mean((yte - b1.predict(Xte)) ** 2))
+    assert l2_lin < 0.7 * l2_const, (l2_lin, l2_const)
+    # measured reference-CLI level on this exact setup: 0.0911
+    assert l2_lin < 0.11, l2_lin
+
+
+def test_model_file_roundtrip():
+    X, y, Xte, _ = _linear_data(seed=1)
+    b = lgb.train({**PARAMS, "linear_tree": True},
+                  lgb.Dataset(X, label=y), num_boost_round=20)
+    s = b.model_to_string()
+    assert "is_linear=1" in s
+    assert "leaf_const=" in s and "leaf_coeff=" in s
+    b2 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(b.predict(Xte), b2.predict(Xte),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_nan_rows_fall_back_to_constant_leaf():
+    X, y, Xte, _ = _linear_data(seed=2)
+    b = lgb.train({**PARAMS, "linear_tree": True},
+                  lgb.Dataset(X, label=y), num_boost_round=15)
+    Xnan = Xte.copy()
+    Xnan[:, :] = np.nan
+    p = b.predict(Xnan)
+    assert np.isfinite(p).all()
+    # all-NaN rows traverse by missing defaults and get CONSTANT leaf
+    # values: the prediction must match the constant-only walk
+    total = np.zeros(Xnan.shape[0])
+    for t in b._gbdt.models:
+        leaf = t.get_leaf_index(Xnan)
+        total += t.leaf_value[leaf]
+    np.testing.assert_allclose(p, total, rtol=1e-6, atol=1e-7)
+
+
+def test_first_tree_is_constant():
+    X, y, _, _ = _linear_data(seed=3)
+    b = lgb.train({**PARAMS, "linear_tree": True},
+                  lgb.Dataset(X, label=y), num_boost_round=1)
+    t = b._gbdt.models[0]
+    assert t.is_linear
+    # reference: is_first_tree leaves keep constant outputs
+    # (linear_tree_learner.cpp:252-257)
+    assert all(len(c) == 0 for c in t.leaf_coeff)
+    np.testing.assert_allclose(t.leaf_const, t.leaf_value)
+
+
+def test_contrib_fails_loudly():
+    X, y, Xte, _ = _linear_data(seed=4, n=500)
+    b = lgb.train({**PARAMS, "linear_tree": True},
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+    with pytest.raises(Exception, match="linear"):
+        b.predict(Xte, pred_contrib=True)
+
+
+def test_linear_with_bagging_trains():
+    X, y, Xte, yte = _linear_data(seed=5)
+    b = lgb.train({**PARAMS, "linear_tree": True, "bagging_freq": 1,
+                   "bagging_fraction": 0.7},
+                  lgb.Dataset(X, label=y), num_boost_round=30)
+    l2 = float(np.mean((yte - b.predict(Xte)) ** 2))
+    assert l2 < 0.3, l2
+
+
+def test_linear_refit_with_decay():
+    X, y, _, _ = _linear_data(seed=6, n=2000)
+    b = lgb.train({**PARAMS, "linear_tree": True},
+                  lgb.Dataset(X, label=y), num_boost_round=10)
+    rng = np.random.RandomState(7)
+    X2 = rng.normal(size=X.shape).astype(np.float32)
+    y2 = (X2 @ rng.normal(size=X.shape[1])).astype(np.float32)
+    b2 = b.refit(X2, y2, decay_rate=0.5)
+    assert all(t.is_linear for t in b2._gbdt.models)
+    # refitted model differs and still predicts finitely
+    assert b2.model_to_string() != b.model_to_string()
+    assert np.isfinite(b2.predict(X2)).all()
