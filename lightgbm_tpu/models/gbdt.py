@@ -563,7 +563,8 @@ class GBDT:
             for it, tree in enumerate(self.models):
                 k = it % self.num_tree_per_iteration
                 leaf = tree.get_leaf_binned(Xv, self)
-                scores[k] += tree.leaf_value[leaf]
+                scores[k] += self._tree_output(tree, self._raw_or_none(ds),
+                                               leaf)
         self._valid_scores.append(jnp.asarray(scores))
         self.valid_sets.append(ds)
         self.valid_names.append(name)
@@ -872,7 +873,8 @@ class GBDT:
         for i, tree in enumerate(trees):
             self._ensure_binned_traversal(tree)
             leaf = tree.get_leaf_binned(Xb, self)
-            add[i % K] += tree.leaf_value[leaf].astype(np.float32)
+            add[i % K] += np.asarray(self._tree_output(
+                tree, self._raw_or_none(self.train_set), leaf), np.float32)
         if self.N_pad != self.num_data:
             add = np.pad(add, ((0, 0), (0, self.N_pad - self.num_data)))
         self.scores = self.scores + self._put_rows(jnp.asarray(add),
@@ -934,13 +936,14 @@ class GBDT:
         scores by the LINEAR outputs, and record the host tree."""
         from .linear import fit_linear_models
 
-        host = jax.device_get(tree_dev)
-        tree = self._device_tree_to_host(host)
         nd = self.num_data
-        lor = np.asarray(jax.device_get(leaf_of_row))[:nd]
-        g = np.asarray(jax.device_get(g_dev))[:nd]
-        h = np.asarray(jax.device_get(h_dev))[:nd]
-        bag = np.asarray(jax.device_get(in_bag))[:nd]
+        host, lor, g, h, bag = jax.device_get(
+            (tree_dev, leaf_of_row, g_dev, h_dev, in_bag))
+        tree = self._device_tree_to_host(host)
+        lor = np.asarray(lor)[:nd]
+        g = np.asarray(g)[:nd]
+        h = np.asarray(h)[:nd]
+        bag = np.asarray(bag)[:nd]
         # materialize pending first so model order stays iteration-major
         self._materialize_models()
         is_first = len(self._models) < self.num_tree_per_iteration
@@ -1049,10 +1052,12 @@ class GBDT:
         for k in range(K):
             tree = self.models.pop()
             kk = K - 1 - k
-            # subtract this tree's contribution from the scores
+            # subtract this tree's contribution from the scores (linear
+            # trees contributed their LINEAR outputs, tree.cpp:130-155)
             leaf = tree.get_leaf_binned(
                 self.train_set.X_binned[:self.num_data], self)
-            contrib = np.asarray(tree.leaf_value[leaf], np.float32)
+            contrib = np.asarray(self._tree_output(tree, self._raw_or_none(
+                self.train_set), leaf), np.float32)
             if self.N_pad != self.num_data:
                 contrib = np.pad(contrib, (0, self.N_pad - self.num_data))
             self.scores = self.scores.at[kk].add(
@@ -1060,8 +1065,26 @@ class GBDT:
             for vi, ds in enumerate(self.valid_sets):
                 leaf_v = tree.get_leaf_binned(ds.X_binned, self)
                 self._valid_scores[vi] = self._valid_scores[vi].at[kk].add(
-                    -jnp.asarray(tree.leaf_value[leaf_v], dtype=jnp.float32))
+                    -jnp.asarray(self._tree_output(
+                        tree, self._raw_or_none(ds), leaf_v),
+                        dtype=jnp.float32))
         self.iter -= 1
+
+    @staticmethod
+    def _raw_or_none(ds):
+        return getattr(ds, "raw_data", None)
+
+    def _tree_output(self, tree: Tree, raw, leaf: np.ndarray) -> np.ndarray:
+        """Per-row score contribution of `tree` for precomputed leaf
+        indices: constant leaf values, or the linear outputs for linear
+        trees (requires the dataset's raw values)."""
+        if not getattr(tree, "is_linear", False):
+            return tree.leaf_value[leaf]
+        if raw is None:
+            log_fatal("replaying a linear tree onto scores requires the "
+                      "dataset's raw feature values")
+        from .linear import linear_output_for_leaves
+        return linear_output_for_leaves(tree, np.asarray(raw), leaf)
 
     # ------------------------------------------------------------------
     def _device_tree_to_host(self, host: Any) -> Tree:

@@ -99,6 +99,11 @@ def fit_linear_models(
     ends = np.searchsorted(sorted_leaf, np.arange(n_leaves), side="right")
 
     out = np.zeros(N, np.float64)
+    # capture the PREVIOUS model before overwriting (refit decay blends
+    # against it)
+    old_const_arr = np.asarray(tree.leaf_const, np.float64).copy()
+    old_feat_list = list(tree.leaf_features)
+    old_coef_list = list(tree.leaf_coeff)
     tree.leaf_const = np.zeros(n_leaves, np.float64)
     new_features: List[List[int]] = []
     new_coeffs: List[List[float]] = []
@@ -120,7 +125,7 @@ def fit_linear_models(
             # not enough valid rows: constant leaf
             # (linear_tree_learner.cpp:333-343)
             if is_refit:
-                old_const = float(tree.leaf_const[li])
+                old_const = float(old_const_arr[li])
                 tree.leaf_const[li] = decay_rate * old_const \
                     + (1.0 - decay_rate) * const_fallback
             else:
@@ -151,9 +156,8 @@ def fit_linear_models(
         fvec = [int(inner_to_real[feats[j]]) for j in keep]
         const = float(coeffs[k]) * shrinkage
         if is_refit:
-            old_const = float(tree.leaf_const[li])
-            old_coeffs = dict(zip(tree.leaf_features[li],
-                                  tree.leaf_coeff[li]))
+            old_const = float(old_const_arr[li])
+            old_coeffs = dict(zip(old_feat_list[li], old_coef_list[li]))
             cvec = [decay_rate * old_coeffs.get(f, 0.0)
                     + (1.0 - decay_rate) * c
                     for f, c in zip(fvec, cvec)]
@@ -173,3 +177,27 @@ def fit_linear_models(
     tree.leaf_features = new_features
     tree.leaf_coeff = new_coeffs
     return out
+
+
+def linear_output_for_leaves(tree, raw: np.ndarray,
+                             leaf: np.ndarray) -> np.ndarray:
+    """Per-row output of a linear tree given precomputed leaf indices
+    (training-time binned partition): const + coeffs . raw with the
+    constant-leaf NaN fallback. Used to replay linear trees onto scores
+    (continued training, rollback, valid-set replay)."""
+    out = tree.leaf_const[leaf].astype(np.float64).copy()
+    nan_found = np.zeros(raw.shape[0], bool)
+    for li in range(tree.num_leaves):
+        feats = tree.leaf_features[li]
+        if not feats:
+            continue
+        rows = leaf == li
+        if not rows.any():
+            continue
+        vals = raw[np.ix_(rows, feats)].astype(np.float64)
+        bad = np.isnan(vals).any(axis=1)
+        out[rows] += np.where(
+            bad[:, None], 0.0,
+            vals * np.asarray(tree.leaf_coeff[li])[None, :]).sum(axis=1)
+        nan_found[np.flatnonzero(rows)[bad]] = True
+    return np.where(nan_found, tree.leaf_value[leaf], out)

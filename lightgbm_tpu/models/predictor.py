@@ -252,6 +252,9 @@ def predict_margin_device(packed: PackedModel, X) -> "object":
     if packed.num_cat > 0:
         raise ValueError("predict_margin_device does not support "
                          "categorical splits; use predict_margin")
+    if packed.has_linear:
+        raise ValueError("predict_margin_device does not support linear "
+                         "leaves; use predict_margin")
     import jax
     import jax.numpy as jnp
 

@@ -641,6 +641,45 @@ def grow_tree_wave(
         bfr=jnp.zeros((L,), bool),
     )
 
+    # wide/categorical/EFB TPU wave path (no feature-count cliff): used
+    # when the fused narrow megakernel cannot (see use_apply sites)
+    use_apply = _use_pallas(X_t, B) and not use_mega
+
+    def dec_go_left(tbl_leaf, feat, thr, dl, iscat, bits):
+        """[K, N] go-left decision of EVERY row under each table entry's
+        split, vectorized over entries (inactive entries produce garbage
+        bits that the membership kernel never reads). Bundle unpacking
+        follows FastFeatureBundling's inverse (dataset.cpp:251);
+        categorical tests the bin bitset."""
+        featc = jnp.clip(feat, 0, F - 1)
+        if cfg.bundled:
+            colK = jnp.asarray(cfg.bundle_col, jnp.int32)[featc]
+            src = jnp.take(X_t, colK, axis=0).astype(jnp.int32) & 0xFF
+            off = jnp.asarray(cfg.bundle_off, jnp.int32)[featc][:, None]
+            nbf = jnp.asarray(cfg.bundle_nb, jnp.int32)[featc][:, None]
+            dbf = jnp.asarray(cfg.bundle_db, jnp.int32)[featc][:, None]
+            rb = src - off
+            inr = (rb >= 0) & (rb < nbf - 1)
+            unp = jnp.where(inr, rb + (rb >= dbf), dbf)
+            binv = jnp.where(off < 0, src, unp)
+        else:
+            binv = jnp.take(X_t, featc, axis=0).astype(jnp.int32) & 0xFF
+        mt = meta.missing_type[featc][:, None]
+        db = meta.default_bin[featc][:, None]
+        nb = meta.num_bins[featc][:, None]
+        miss = ((mt == MISSING_ZERO) & (binv == db)) | \
+               ((mt == MISSING_NAN) & (binv == nb - 1))
+        gl = jnp.where(miss, dl[:, None].astype(bool),
+                       binv <= thr[:, None])
+        if cfg.has_categorical:
+            widx = jnp.clip(binv >> 5, 0, W - 1)
+            wsel = jnp.zeros(binv.shape, jnp.uint32)
+            for w in range(W):
+                wsel = jnp.where(widx == w, bits[:, w:w + 1], wsel)
+            gl_cat = ((wsel >> (binv & 31).astype(jnp.uint32)) & 1) == 1
+            gl = jnp.where(iscat[:, None], gl_cat, gl)
+        return gl
+
     def table_go_left(leaf_of_row, tbl_leaf, sp_feat, sp_thr, sp_dleft,
                       sp_iscat, sp_bits):
         """Evaluate each in-table row against its leaf's split; pure
@@ -1038,6 +1077,35 @@ def grow_tree_wave(
                 kidx_m, mega_branches, (st.leaf_of_row, tbl16))
             st = st._replace(leaf_of_row=leaf_of_row)
             slot_small = None
+        elif use_apply:
+            # ---- wide/categorical/EFB TPU path: per-(entry, row) go-left
+            # decisions are INDEPENDENT of leaf membership, so they are
+            # precomputed here as a [128, N] bit matrix in plain XLA
+            # (vectorized over entries — bundle unpack and categorical
+            # bitsets included), and a slim kernel resolves membership
+            # (wave_apply_pallas). The histogram runs as the F-gridded
+            # slots kernel, so no feature-count cliff.
+            glA = dec_go_left(app_leaf, bs2.feature, bs2.threshold,
+                              bs2.default_left, iscat2, bits2)
+            glC = dec_go_left(cand_tbl, bs.feature, bs.threshold,
+                              bs.default_left, st.best_is_cat[cand],
+                              st.best_bitset[cand])
+            land_small = glC == smaller_is_left[:, None]
+            dec = (glA.astype(jnp.int8)
+                   | (land_small.astype(jnp.int8) << 1))     # [KMAX, N]
+            if KMAX < 128:
+                dec = jnp.pad(dec, ((0, 128 - KMAX), (0, 0)))
+            tbl_apply = jnp.zeros((16, 128), jnp.int32)
+            pad128 = (0, 128 - KMAX)
+            tbl_apply = tbl_apply.at[0].set(
+                jnp.pad(app_leaf, pad128, constant_values=-1))
+            tbl_apply = tbl_apply.at[7].set(
+                jnp.pad(cand_tbl, pad128, constant_values=-1))
+            tbl_apply = tbl_apply.at[15].set(jnp.full((128,), nl0))
+            from .histogram_pallas import wave_apply_pallas
+            leaf_of_row, slot_small = wave_apply_pallas(
+                dec, st.leaf_of_row, tbl_apply)
+            st = st._replace(leaf_of_row=leaf_of_row)
         else:
             # ---- portable path: RELABEL applied splits, then evaluate
             # candidate membership on the NEW leaf (elementwise

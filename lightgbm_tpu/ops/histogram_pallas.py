@@ -554,6 +554,93 @@ def wave_pass_pallas(
     return newlor[0, :N], hist
 
 
+def _wave_apply_kernel(dec_ref, lor_ref, tbl_ref, nl0_ref, newlor_ref,
+                       slot_ref):
+    """Grid (N_blocks,). dec_ref [128, R] i8: bit0 = apply go-left under
+    entry k's split, bit1 = row lands in entry k's SMALLER child;
+    lor_ref [1, R]; tbl_ref [128, 8] i32 (col 0 applied leaf id, col 2
+    candidate leaf id; -1 = inactive); nl0_ref [1] i32 SMEM.
+    Outputs new_lor [1, R] and candidate slot ids [1, R] (-1 = none).
+
+    The decisions were precomputed OUTSIDE (XLA elementwise on extracted
+    feature columns), which is what makes this kernel independent of the
+    feature count, categorical bitsets, and EFB bundle unpacking — it
+    only resolves leaf membership."""
+    R = lor_ref.shape[1]
+    K = 128
+    dec = dec_ref[...].astype(jnp.int32)                   # [128, R]
+    lor = lor_ref[0, :]
+    iota_k = jax.lax.broadcasted_iota(jnp.int32, (K, R), 0)
+
+    mA = lor[None, :] == tbl_ref[:, 0:1]                   # [128, R]
+    glA = jnp.sum(jnp.where(mA, dec & 1, 0), axis=0)       # [R]
+    inA = jnp.sum(jnp.where(mA, 1, 0), axis=0)
+    slotA = jnp.sum(jnp.where(mA, iota_k, 0), axis=0)
+    nl0 = nl0_ref[0]
+    new_lor = jnp.where((inA == 1) & (glA == 0), nl0 + slotA, lor)
+    newlor_ref[0, :] = new_lor
+
+    mC = new_lor[None, :] == tbl_ref[:, 2:3]               # [128, R]
+    in_small = jnp.sum(jnp.where(mC, (dec >> 1) & 1, 0), axis=0)
+    slotC = jnp.sum(jnp.where(mC, iota_k, 0), axis=0)
+    inC = jnp.sum(jnp.where(mC, 1, 0), axis=0)
+    slot_ref[0, :] = jnp.where((inC == 1) & (in_small == 1), slotC, -1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def wave_apply_pallas(
+    dec: jnp.ndarray,          # [128, N] i8 decision bits per (entry, row)
+    leaf_of_row: jnp.ndarray,  # [N] int32
+    table: jnp.ndarray,        # [T_ROWS, 128] int32 semantic wave table
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Split application + candidate smaller-child slot assignment for
+    the WIDE/categorical/EFB wave path: returns (new_leaf_of_row [N],
+    slot_small [N] with -1 = no candidate). The histogram then runs as a
+    separate build_histogram_slots_pallas pass (whose grid feature-blocks
+    arbitrary F)."""
+    N = leaf_of_row.shape[0]
+    n_blk = N_BLK if N >= N_BLK else max(_round_up(N, 256), 256)
+    Np = _round_up(N, n_blk)
+    d = dec.astype(jnp.int8)
+    if d.shape[1] != Np:
+        d = jnp.pad(d, ((0, 0), (0, Np - d.shape[1])))
+    lor = leaf_of_row.astype(jnp.int32)
+    if Np != N:
+        lor = jnp.pad(lor, (0, Np - N), constant_values=-1)
+    t = table.astype(jnp.int32)
+    tblp = jnp.stack([t[_T_APP_LEAF], t[_T_APP_LEAF] * 0,
+                      t[_T_CAND_LEAF], t[_T_APP_LEAF] * 0,
+                      t[_T_APP_LEAF] * 0, t[_T_APP_LEAF] * 0,
+                      t[_T_APP_LEAF] * 0, t[_T_APP_LEAF] * 0], axis=1)
+    nl0 = t[_T_NL0, 0:1]
+    newlor, slot = pl.pallas_call(
+        _wave_apply_kernel,
+        grid=(Np // n_blk,),
+        in_specs=[
+            pl.BlockSpec((128, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((128, 8), lambda n: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, Np), jnp.int32),
+            jax.ShapeDtypeStruct((1, Np), jnp.int32),
+        ],
+        interpret=interpret,
+    )(d, lor[None, :], tblp, nl0)
+    return newlor[0, :N], slot[0, :N]
+
+
 @functools.partial(jax.jit, static_argnames=("num_bins", "interpret"))
 def wave_relabel_pallas(
     X_binned_t: jnp.ndarray,   # [F, N] int8/uint8 (feature-major, F <= 32)

@@ -254,3 +254,45 @@ def test_take_leaf_values_exact():
         got = take_leaf_values_pallas(jnp.asarray(vals), jnp.asarray(lor),
                                       interpret=True)
         np.testing.assert_array_equal(np.asarray(got), vals[lor])
+
+
+def test_wave_apply_matches_reference():
+    """wave_apply_pallas (wide/categorical/EFB path): precomputed
+    per-(entry, row) decision bits -> relabel + candidate slots."""
+    from lightgbm_tpu.ops.histogram_pallas import wave_apply_pallas
+    rng = np.random.RandomState(11)
+    N, K = 3000, 12
+    lor = rng.randint(0, 20, size=N).astype(np.int32)
+    app_leaf = np.full(128, -1, np.int32)
+    app_leaf[:K] = rng.choice(20, K, replace=False)
+    cand_leaf = np.full(128, -1, np.int32)
+    cand_leaf[:K] = rng.choice(40, K, replace=False)
+    nl0 = 20
+    glA = rng.randint(0, 2, size=(128, N))
+    small = rng.randint(0, 2, size=(128, N))
+    dec = (glA | (small << 1)).astype(np.int8)
+
+    tbl = np.full((16, 128), -1, np.int32)
+    tbl[0] = app_leaf
+    tbl[7] = cand_leaf
+    tbl[15] = nl0
+
+    got_lor, got_slot = wave_apply_pallas(
+        jnp.asarray(dec), jnp.asarray(lor), jnp.asarray(tbl),
+        interpret=True)
+
+    # numpy reference
+    ref_lor = lor.copy()
+    for k in range(128):
+        if app_leaf[k] < 0:
+            continue
+        m = (lor == app_leaf[k]) & (glA[k] == 0)
+        ref_lor[m] = nl0 + k
+    ref_slot = np.full(N, -1, np.int64)
+    for k in range(128):
+        if cand_leaf[k] < 0:
+            continue
+        m = (ref_lor == cand_leaf[k]) & (small[k] == 1)
+        ref_slot[m] = k
+    np.testing.assert_array_equal(np.asarray(got_lor), ref_lor)
+    np.testing.assert_array_equal(np.asarray(got_slot), ref_slot)

@@ -111,3 +111,43 @@ def test_linear_refit_with_decay():
     # refitted model differs and still predicts finitely
     assert b2.model_to_string() != b.model_to_string()
     assert np.isfinite(b2.predict(X2)).all()
+
+
+def test_refit_decay_keeps_old_model_at_one():
+    X, y, Xte, _ = _linear_data(seed=8, n=1500)
+    b = lgb.train({**PARAMS, "linear_tree": True},
+                  lgb.Dataset(X, label=y), num_boost_round=8)
+    p0 = b.predict(Xte)
+    # decay_rate=1.0 keeps the old model exactly
+    b_keep = b.refit(X, y, decay_rate=1.0)
+    np.testing.assert_allclose(b_keep.predict(Xte), p0, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_rollback_and_continue_consistency():
+    X, y, _, _ = _linear_data(seed=9, n=1500)
+    ds = lgb.Dataset(X, label=y)
+    b = lgb.train({**PARAMS, "linear_tree": True}, ds, num_boost_round=6)
+    g = b._gbdt
+    g.rollback_one_iter()
+    # scores after rollback must equal the remaining model's raw output
+    import numpy as _np
+    scores = _np.asarray(g.scores[0][:len(y)])
+    raw_pred = _np.zeros(len(y))
+    for t in g.models:
+        leaf = t.get_leaf_binned(g.train_set.X_binned[:len(y)], g)
+        from lightgbm_tpu.models.linear import linear_output_for_leaves
+        raw_pred += linear_output_for_leaves(t, X, leaf)
+    _np.testing.assert_allclose(scores, raw_pred, rtol=1e-4, atol=1e-5)
+
+
+def test_continued_training_with_linear_init_model():
+    X, y, Xte, yte = _linear_data(seed=10)
+    b = lgb.train({**PARAMS, "linear_tree": True},
+                  lgb.Dataset(X, label=y), num_boost_round=10)
+    l2_a = float(np.mean((yte - b.predict(Xte)) ** 2))
+    b2 = lgb.train({**PARAMS, "linear_tree": True},
+                   lgb.Dataset(X, label=y), num_boost_round=10,
+                   init_model=b)
+    l2_b = float(np.mean((yte - b2.predict(Xte)) ** 2))
+    assert l2_b < l2_a, (l2_b, l2_a)
