@@ -49,8 +49,43 @@ def train_throughput(rows, cols, iters, max_bin, num_leaves=255):
                 train_auc=round(auc, 5))
 
 
+def predict_throughput(rows=4_000_000, cols=28, trees=32):
+    import jax
+    import jax.numpy as jnp
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.models.predictor import predict_margin_device
+
+    rng = np.random.RandomState(42)
+    X = rng.normal(size=(rows, cols)).astype(np.float32)
+    w = rng.normal(size=cols)
+    y = (X @ w + rng.normal(scale=0.5, size=rows) > 0).astype(np.float32)
+    b = lgb.Booster(params=dict(objective="binary", num_leaves=255,
+                                max_bin=63, verbose=-1),
+                    train_set=lgb.Dataset(X, label=y))
+    b.update_batch(trees)
+    g = b._gbdt
+    _ = g.models
+    Xd = jnp.asarray(X)            # device-resident input (serving setup)
+    _ = predict_margin_device(g.models, 1, Xd)          # compile
+    t0 = time.perf_counter()
+    _ = predict_margin_device(g.models, 1, Xd)
+    dt_dev = time.perf_counter() - t0
+    sub = 200_000
+    pm = g._packed_model(0, len(g.models))
+    t0 = time.perf_counter()
+    _ = pm.predict_margin(X[:sub])
+    dt_host = (time.perf_counter() - t0) * (rows / sub)
+    return dict(rows=rows, cols=cols, trees=trees,
+                device_rows_per_sec=round(rows / dt_dev, 1),
+                host_rows_per_sec=round(rows / dt_host, 1),
+                device_speedup=round(dt_host / dt_dev, 1))
+
+
 def main():
     out = {"description": "lightgbm_tpu sidecar benchmarks (one v5e chip)"}
+    out["predict_throughput"] = predict_throughput()
+    print(json.dumps(out["predict_throughput"]))
     # F-sweep at fixed rows x iters: the per-(row, feature) rate is the
     # cliff detector (a fixed-F fast path would crater beyond its limit)
     sweep = []

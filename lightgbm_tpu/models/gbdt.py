@@ -1198,6 +1198,9 @@ class GBDT:
                     pred_early_stop: bool = False,
                     pred_early_stop_freq: int = 10,
                     pred_early_stop_margin: float = 10.0) -> np.ndarray:
+        # f32 inputs may route to the device predictor below — capture
+        # the original dtype before the host paths' f64 upcast
+        x_was_f32 = getattr(X, "dtype", None) == np.float32
         X = np.asarray(X, dtype=np.float64)
         K = self.num_tree_per_iteration
         total_iters = len(self.models) // K
@@ -1205,6 +1208,35 @@ class GBDT:
             total_iters, start_iteration + num_iteration)
         if end <= start_iteration:
             return np.zeros((K, X.shape[0]), dtype=np.float64)
+        # large FLOAT32 batches score on the accelerator (the matmul
+        # predictor, models/predictor.py predict_margin_device — the
+        # reference's parallel Predictor analog, application/predictor.hpp).
+        # f32-only: the device compares in f32 with floored thresholds,
+        # which routes f32 values exactly like the host's f64 walk; f64
+        # inputs with sub-f32 precision stay on the host. Small batches
+        # and early-stop stay on the host walk too.
+        if (x_was_f32 and X.shape[0] >= 100_000 and not pred_early_stop
+                and not any(getattr(t, "is_linear", False)
+                            for t in self.models)):
+            try:
+                on_tpu = jax.default_backend() == "tpu"
+            except RuntimeError:
+                on_tpu = False
+            if on_tpu:
+                from .predictor import (build_device_tables,
+                                        predict_margin_device)
+                trees = self.models[start_iteration * K:end * K]
+                key = (start_iteration, end, len(self.models))
+                cache = getattr(self, "_device_tables_cache", None)
+                if cache is None or cache[0] != key:
+                    cache = (key, build_device_tables(trees, K, X.shape[1]))
+                    self._device_tables_cache = cache
+                out = predict_margin_device(trees, K,
+                                            X.astype(np.float32),
+                                            tables=cache[1])
+                if self.average_output and end > start_iteration:
+                    out /= (end - start_iteration)
+                return out
         pm = self._packed_model(start_iteration, end)
         # early stop is margin-based and meaningless for averaged (RF)
         # output (prediction_early_stop.cpp operates on boosted margins)

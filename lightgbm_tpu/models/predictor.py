@@ -17,8 +17,10 @@ Python loop per tree. The same packed arrays drive:
                           whose margin clears the bound drop out of later
                           groups (binary: |margin|, multiclass: top-2 gap
                           — prediction_early_stop.cpp:14-58)
-  * predict_margin_device: the same lockstep walk under jit for
-                          device-resident scoring of raw features
+  * predict_margin_device: an MXU matmul formulation for accelerator
+                          batch scoring (path-mismatch counting; see its
+                          docstring) — numeric, missing and categorical
+                          splits; linear leaves stay on the host paths
 """
 
 from __future__ import annotations
@@ -243,59 +245,205 @@ class PackedModel:
         return lv.reshape(self.T // self.K, self.K).sum(axis=0)
 
 
-def predict_margin_device(packed: PackedModel, X) -> "object":
-    """Device-side batch margins over raw features: the same lockstep
-    walk under jit (CUDA analog: gbdt_prediction with CUDATree,
-    cuda_tree.hpp:29). X is [N, F] float32 on device; returns [K, N]
-    f32 margins. Numeric splits only — categorical models must use the
-    host paths (predict_margin / predict_single)."""
-    if packed.num_cat > 0:
-        raise ValueError("predict_margin_device does not support "
-                         "categorical splits; use predict_margin")
-    if packed.has_linear:
+def _tree_path_tables(tree, M_pad, L_pad, W):
+    """Per-tree path tables for the matmul predictor: P [L_pad, M_pad]
+    (+1 where leaf l's path goes RIGHT at node m, -1 where LEFT, 0 off
+    path), c [L_pad] = number of LEFT edges on the path, so
+    mismatches(l, r) = c[l] + sum_m P[l, m] * go_left[m, r] equals zero
+    exactly at the row's leaf. Also packs per-node split metadata."""
+    n, m = tree.num_leaves, max(tree.num_leaves - 1, 0)
+    P = np.zeros((L_pad, M_pad), np.float32)
+    c = np.zeros(L_pad, np.float32)
+    stack = [(0, [])] if m > 0 else []
+    while stack:
+        node, path = stack.pop()
+        for child, is_left in ((int(tree.left_child[node]), True),
+                               (int(tree.right_child[node]), False)):
+            p2 = path + [(node, is_left)]
+            if child < 0:
+                for nd, il in p2:
+                    # go_left=1 on a LEFT edge is a match: P=-1, c+=1
+                    P[~child, nd] = -1.0 if il else 1.0
+                    c[~child] += 1.0 if il else 0.0
+            else:
+                stack.append((child, p2))
+    # unreached padding leaves must never win the ==0 test
+    c[n:] = 1e9
+    if n == 1:
+        c[0] = 0.0          # stump: single leaf always matches
+    feat = np.zeros(M_pad, np.int32)
+    thr = np.zeros(M_pad, np.float32)
+    dt = np.zeros(M_pad, np.int8)
+    bits = np.zeros((M_pad, W), np.uint32)
+    lv = np.zeros(L_pad, np.float32)
+    lv[:n] = tree.leaf_value
+    if m > 0:
+        feat[:m] = tree.split_feature
+        # the f64 threshold floored to the largest f32 <= it: for f32
+        # feature values v, (v <= thr_f64) == (v <= thr_f32floor), so the
+        # device's single-precision compare routes boundary rows exactly
+        # like the host's double-precision walk
+        t64 = np.asarray(tree.threshold, np.float64)
+        t32 = t64.astype(np.float32)
+        over = t32.astype(np.float64) > t64
+        t32[over] = np.nextafter(t32[over], np.float32(-np.inf))
+        thr[:m] = t32
+        dt[:m] = tree.decision_type
+        for i in range(m):
+            if dt[i] & _CATEGORICAL_MASK:
+                ci = int(tree.threshold_in_bin[i])
+                a, b = tree.cat_boundaries[ci], tree.cat_boundaries[ci + 1]
+                words = tree.cat_threshold[a:b][:W]
+                bits[i, :len(words)] = words
+    return P, c, feat, thr, dt, bits, lv
+
+
+def build_device_tables(trees, num_class_models: int, F: int):
+    """Upload per-tree path tables for predict_margin_device (cacheable
+    across calls while the model is unchanged — a serving loop should
+    reuse them like the host _packed_model cache)."""
+    if any(getattr(t, "is_linear", False) for t in trees):
         raise ValueError("predict_margin_device does not support linear "
                          "leaves; use predict_margin")
+    import jax.numpy as jnp
+
+    M_pad = max(max((t.num_leaves - 1 for t in trees), default=1), 1)
+    M_pad = int(np.ceil(M_pad / 8) * 8)
+    L_pad = int(np.ceil(max(t.num_leaves for t in trees) / 8) * 8)
+    if any(t.num_cat > 0 for t in trees):
+        W = max(int(np.diff(t.cat_boundaries).max()) for t in trees
+                if t.num_cat > 0)
+    else:
+        W = 0          # the categorical block compiles out entirely
+    tabs = [_tree_path_tables(t, M_pad, L_pad, W) for t in trees]
+    P = jnp.asarray(np.stack([a[0] for a in tabs]))       # [T, L, M]
+    c = jnp.asarray(np.stack([a[1] for a in tabs]))       # [T, L]
+    feat = np.stack([a[2] for a in tabs])                  # [T, M]
+    thr = jnp.asarray(np.stack([a[3] for a in tabs]))
+    dt = jnp.asarray(np.stack([a[4] for a in tabs]).astype(np.int32))
+    bits = jnp.asarray(np.stack([a[5] for a in tabs]))     # [T, M, W]
+    lv = jnp.asarray(np.stack([a[6] for a in tabs]))       # [T, L]
+    # exact one-hot feature selector (bf16 one-hots are exact; HIGHEST
+    # keeps the f32 values un-rounded through the MXU)
+    ohf = jnp.asarray((feat[:, :, None]
+                       == np.arange(F)[None, None, :]).astype(np.float32))
+    return (ohf, thr, dt, bits, P, c, lv, num_class_models)
+
+
+def predict_margin_device(trees, num_class_models: int, X,
+                          chunk: int = 65536, tables=None) -> "object":
+    """Device batch margins — the TPU-native matmul formulation (no
+    gathers, no per-row walks; CUDA analog: gbdt_prediction kernels over
+    CUDATree, cuda_tree.hpp:29, rebuilt for the MXU):
+
+      1. per tree, node decisions for ALL rows at once: feature values
+         arrive via an exact one-hot contraction oh_feat @ X_chunk
+         ([M, F] @ [F, n]), then missing/categorical logic elementwise;
+      2. each row's leaf is the unique leaf whose path constraints all
+         hold: mismatch counts for ALL (leaf, row) pairs are ONE matmul
+         P @ go_left + c, and the leaf value lands via a second exact
+         one-hot contraction over (count == 0).
+
+    X is [N, F] float32 (device or host); returns [K, N] f32 margins.
+    Linear leaves are not supported (use the host path)."""
     import jax
     import jax.numpy as jnp
 
-    sf = jnp.asarray(packed.split_feature)
-    thr = jnp.asarray(packed.threshold.astype(np.float32))
-    dt = jnp.asarray(packed.decision_type.astype(np.int32))
-    lc = jnp.asarray(packed.left_child)
-    rc = jnp.asarray(packed.right_child)
-    lval = jnp.asarray(packed.leaf_value.astype(np.float32))
-    nstart = jnp.asarray(packed.node_start[:-1].astype(np.int32))
-    lstart = jnp.asarray(packed.leaf_start[:-1].astype(np.int32))
-    single = jnp.asarray(packed.single_leaf)
-    T, K = packed.T, packed.K
+    if tables is None:
+        tables = build_device_tables(trees, num_class_models, X.shape[1])
+    ohf, thr, dt, bits, P, c, lv, K = tables
+    F = X.shape[1]
+    N = X.shape[0]
+    Xd = jnp.asarray(np.asarray(X, np.float32)) \
+        if not isinstance(X, jnp.ndarray) else X.astype(jnp.float32)
+    Np = int(np.ceil(N / chunk) * chunk)
+    Xt = jnp.pad(Xd, ((0, Np - N), (0, 0))).T.reshape(F, Np // chunk,
+                                                      chunk)
+    out = np.asarray(jax.device_get(_get_device_margin()(
+        Xt, ohf, thr, dt, bits, P, c, lv, K=K)))[:, :N]
+    return out.astype(np.float64)
 
-    @jax.jit
-    def run(X):
-        N = X.shape[0]
-        node0 = jnp.where(single[None, :], -1, 0) * jnp.ones(
-            (N, 1), jnp.int32)
 
-        def cond(node):
-            return jnp.any(node >= 0)
+_DEVICE_MARGIN_JIT = None
 
-        def body(node):
-            gnode = jnp.maximum(node, 0) + nstart[None, :]
-            f = sf[gnode]
-            fval = jnp.take_along_axis(X, f, axis=1)
-            mt = (dt[gnode] >> 2) & 3
-            nan_mask = jnp.isnan(fval)
-            fval_n = jnp.where(nan_mask & (mt != MISSING_NAN), 0.0, fval)
+
+def _get_device_margin():
+    """Module-level jit cache (jax imported lazily — this module must
+    stay importable host-only)."""
+    global _DEVICE_MARGIN_JIT
+    if _DEVICE_MARGIN_JIT is None:
+        import jax
+        _DEVICE_MARGIN_JIT = jax.jit(_device_margin,
+                                     static_argnames=("K",))
+    return _DEVICE_MARGIN_JIT
+
+
+def _device_margin(Xt, ohf, thr, dt, bits, P, c, lv, *, K):
+    """[K, N] margins on device; Xt [F, n_chunks, chunk] f32. Jitted at
+    module level so repeated predict calls with same-shaped models and
+    chunks reuse the compilation."""
+    import jax
+    import jax.numpy as jnp
+
+    hp = jax.lax.Precision.HIGHEST
+    W = int(bits.shape[2])
+
+    def run_chunk(Xc_t):                                   # [F, n]
+        nan_f = jnp.isnan(Xc_t)
+        Xclean = jnp.where(nan_f, 0.0, Xc_t)
+        nan_f32 = nan_f.astype(jnp.float32)
+
+        def per_tree(carry, tab):
+            ohf_t, thr_t, dt_t, bits_t, P_t, c_t, lv_t = tab
+            fval = jax.lax.dot_general(
+                ohf_t, Xclean, (((1,), (0,)), ((), ())),
+                precision=hp)                              # [M, n]
+            nan_mask = jax.lax.dot_general(
+                ohf_t, nan_f32, (((1,), (0,)), ((), ())),
+                precision=hp) > 0.5
+            mt = (dt_t[:, None] >> 2) & 3
+            fval_n = jnp.where(nan_mask, 0.0, fval)
             is_missing = ((mt == MISSING_ZERO)
                           & (jnp.abs(fval_n) <= _KZERO_THRESHOLD)) | \
                          ((mt == MISSING_NAN) & nan_mask)
-            default_left = (dt[gnode] & _DEFAULT_LEFT_MASK) != 0
+            default_left = (dt_t[:, None] & _DEFAULT_LEFT_MASK) != 0
             go_left = jnp.where(is_missing, default_left,
-                                fval_n <= thr[gnode])
-            nxt = jnp.where(go_left, lc[gnode], rc[gnode])
-            return jnp.where(node >= 0, nxt, node)
+                                fval_n <= thr_t[:, None])
+            is_cat = (dt_t[:, None] & _CATEGORICAL_MASK) != 0
+            if W > 0:
+                valid = ~nan_mask & (fval >= 0)
+                iv = jnp.where(valid, fval, 0).astype(jnp.int32)
+                widx = jnp.clip(iv >> 5, 0, W - 1)
+                wsel = jnp.zeros(iv.shape, jnp.uint32)
+                for w in range(W):
+                    wsel = jnp.where(widx == w, bits_t[:, w:w + 1], wsel)
+                in_range = valid & (iv < W * 32)
+                gl_cat = in_range & (
+                    ((wsel >> (iv & 31).astype(jnp.uint32)) & 1) == 1)
+                go_left = jnp.where(is_cat, gl_cat, go_left)
+            # mismatch count per (leaf, row): ONE matmul. Products are
+            # 0/+-1 -> exact in bf16 with f32 accumulation.
+            counts = jax.lax.dot_general(
+                P_t, go_left.astype(jnp.float32),
+                (((1,), (0,)), ((), ())), precision=hp) + c_t[:, None]
+            hit = (counts == 0).astype(jnp.float32)        # [L, n]
+            out = jax.lax.dot_general(
+                lv_t[None, :], hit, (((1,), (0,)), ((), ())),
+                precision=hp)[0]                           # [n]
+            return carry + out.astype(jnp.float32), None
 
-        node = jax.lax.while_loop(cond, body, node0)
-        lv = lval[lstart[None, :] + (~node)]              # [N, T]
-        return lv.reshape(N, T // K, K).sum(axis=1).T     # [K, N]
+        n = Xc_t.shape[1]
+        outs = []
+        for k in range(K):
+            tab_k = (ohf[k::K], thr[k::K], dt[k::K], bits[k::K],
+                     P[k::K], c[k::K], lv[k::K])
+            acc, _ = jax.lax.scan(per_tree, jnp.zeros((n,), jnp.float32),
+                                  tab_k)
+            outs.append(acc)
+        return jnp.stack(outs)                             # [K, n]
 
-    return run(X)
+    def step(_, Xc_t):
+        return None, run_chunk(Xc_t)
+
+    _, outs = jax.lax.scan(step, None, jnp.moveaxis(Xt, 1, 0))
+    return jnp.moveaxis(outs, 0, 1).reshape(outs.shape[1], -1)   # [K, Np]

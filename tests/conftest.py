@@ -8,19 +8,24 @@ without real chips).
 import os
 
 # force CPU even when the shell presets JAX_PLATFORMS (e.g. a real TPU via
-# axon): tests need the virtual 8-device mesh and deterministic fast compiles
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# axon): tests need the virtual 8-device mesh and deterministic fast
+# compiles. LIGHTGBM_TPU_TEST_ON_TPU=1 opts out for the hardware-gated
+# parity suite (tests/test_tpu_parity.py).
+_ON_TPU = os.environ.get("LIGHTGBM_TPU_TEST_ON_TPU", "") == "1"
+if not _ON_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
 # the axon sitecustomize force-registers the TPU plugin via
 # jax.config.update("jax_platforms", "axon,cpu"), which overrides the env
 # var — override it back before any backend is initialized
-jax.config.update("jax_platforms", "cpu")
+if not _ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -121,7 +121,27 @@ def test_device_predictor_parity(binary_model):
     from lightgbm_tpu.models.predictor import predict_margin_device
     bst, X = binary_model
     g = bst._gbdt
-    pm = g._packed_model(0, len(g.models))
     ref = _per_tree_margin(g, X[:256])
-    got = np.asarray(predict_margin_device(pm, jnp.asarray(X[:256])))
+    got = np.asarray(predict_margin_device(
+        g.models, g.num_tree_per_iteration, jnp.asarray(X[:256])))
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-6)
+
+
+def test_device_predictor_parity_with_nan_and_cat():
+    rng = np.random.RandomState(3)
+    N = 2000
+    Xc = rng.randint(0, 12, size=(N, 1)).astype(np.float64)
+    Xn = rng.normal(size=(N, 4))
+    X = np.concatenate([Xc, Xn], axis=1)
+    X[::17, 2] = np.nan
+    y = ((Xc[:, 0] % 3 == 0) ^ (Xn[:, 0] > 0)).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y, categorical_feature=[0]),
+                    num_boost_round=8)
+    g = bst._gbdt
+    from lightgbm_tpu.models.predictor import predict_margin_device
+    ref = _per_tree_margin(g, X[:512])
+    got = np.asarray(predict_margin_device(
+        g.models, g.num_tree_per_iteration, X[:512]))
     np.testing.assert_allclose(got, ref, rtol=2e-5, atol=1e-6)
