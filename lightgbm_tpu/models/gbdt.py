@@ -140,6 +140,8 @@ def build_feature_meta(ds: BinnedDataset,
 class GBDT:
     """Gradient Boosting Decision Trees (reference: src/boosting/gbdt.h:35)."""
 
+    _pre_part = False            # set by _init_train when pre-partitioned
+
     def __init__(self, config: Config, train_set: Optional[BinnedDataset],
                  objective: Optional[ObjectiveFunction],
                  training_metrics: Sequence[Metric] = ()):
@@ -198,16 +200,46 @@ class GBDT:
         self.use_dist = (cfg.tree_learner in ("data", "feature", "voting")
                          and n_dev > 1)
         N_real = ds.num_data
+        self._pre_part = (bool(cfg.pre_partition) and self.use_dist
+                          and jax.process_count() > 1)
         if self.use_dist:
             self.mesh = make_data_mesh()
             self.n_shards = int(self.mesh.devices.size)
-            self.N_pad = pad_rows_to(N_real, self.n_shards)
-            log_info(f"Data-parallel training over {self.n_shards} devices "
-                     f"({N_real} rows padded to {self.N_pad})")
+            if self._pre_part:
+                # pre-partitioned load (dataset_loader.cpp:1162-1213):
+                # every process holds ONLY its own rows; the global row
+                # space is the concatenation of the per-process shards
+                from jax.experimental import multihost_utils
+                nproc = jax.process_count()
+                if self.n_shards % nproc != 0:
+                    log_fatal("pre_partition requires an equal device "
+                              "count per process")
+                counts = np.asarray(multihost_utils.process_allgather(
+                    np.asarray([N_real], np.int64))).reshape(-1)
+                self._local_rows = int(N_real)
+                self.global_num_data = int(counts.sum())
+                # every process pads its host arrays to the same local
+                # size so the global sharded array is uniform
+                per = max(int(counts.max()), 1)
+                self._host_pad = pad_rows_to(per, self.n_shards // nproc)
+                self.N_pad = self._host_pad * nproc
+                log_info(
+                    f"Pre-partitioned data-parallel training: rank "
+                    f"{jax.process_index()}/{nproc} holds {N_real} of "
+                    f"{self.global_num_data} rows; {self.n_shards} "
+                    f"devices, global rows padded to {self.N_pad}")
+                self._dist_guards(cfg)
+            else:
+                self.N_pad = pad_rows_to(N_real, self.n_shards)
+                self._host_pad = self.N_pad
+                log_info(f"Data-parallel training over {self.n_shards} "
+                         f"devices ({N_real} rows padded to "
+                         f"{self.N_pad})")
         else:
             self.mesh = None
             self.n_shards = 1
             self.N_pad = N_real
+            self._host_pad = N_real
 
         max_bin = max((m.num_bin for m in ds.mappers), default=2)
         # EFB: ship the bundled columns to the device instead of the raw
@@ -223,8 +255,8 @@ class GBDT:
             X = ds.X_binned
         self.num_bins_padded = max(_round_up(max_bin, 8), 8)
         Xt_np = np.ascontiguousarray(X.T)                   # [F(b), N]
-        if self.N_pad != N_real:
-            Xt_np = np.pad(Xt_np, ((0, 0), (0, self.N_pad - N_real)))
+        if self._host_pad != N_real:
+            Xt_np = np.pad(Xt_np, ((0, 0), (0, self._host_pad - N_real)))
         self.X_t = self._put_rows(jnp.asarray(Xt_np), row_axis=1)
         self.meta = build_feature_meta(ds, cfg.monotone_constraints,
                                        cfg.interaction_constraints)
@@ -252,10 +284,11 @@ class GBDT:
                 bundle_expand=jnp.asarray(expand.reshape(-1)),
                 bundle_mfb=jnp.asarray(mfb))
         if self.meta.monotone is not None \
-                and cfg.monotone_constraints_method not in ("basic",):
-            log_warning("monotone_constraints_method="
-                        f"{cfg.monotone_constraints_method} is not "
-                        "implemented; using the 'basic' method")
+                and cfg.monotone_constraints_method not in (
+                    "basic", "intermediate"):
+            log_fatal("monotone_constraints_method="
+                      f"{cfg.monotone_constraints_method} is not "
+                      "implemented (use 'basic' or 'intermediate')")
         self.grow_cfg = GrowConfig(
             num_leaves=cfg.num_leaves,
             max_depth=cfg.max_depth,
@@ -294,6 +327,8 @@ class GBDT:
             feature_fraction_bynode=float(cfg.feature_fraction_bynode),
             extra_trees=bool(cfg.extra_trees),
             extra_seed=int(cfg.extra_seed),
+            monotone_method=str(cfg.monotone_constraints_method),
+            monotone_penalty=float(cfg.monotone_penalty),
         )
 
         # grower selection: "wave" (default via auto) applies batched
@@ -424,8 +459,8 @@ class GBDT:
             if a is None:
                 return None
             a = np.asarray(a)
-            if self.N_pad != N:
-                a = np.pad(a, (0, self.N_pad - N))
+            if self._host_pad != N:
+                a = np.pad(a, (0, self._host_pad - N))
             return a
 
         self.label_dev = (self._put_rows(jnp.asarray(pad1(md.label)))
@@ -441,8 +476,8 @@ class GBDT:
             self._has_init_score = True
         else:
             self._has_init_score = False
-        if self.N_pad != N:
-            scores = np.pad(scores, ((0, 0), (0, self.N_pad - N)))
+        if self._host_pad != N:
+            scores = np.pad(scores, ((0, 0), (0, self._host_pad - N)))
         self.scores = self._put_rows(jnp.asarray(scores), row_axis=1)
 
         if self.objective is not None:
@@ -452,17 +487,59 @@ class GBDT:
 
         # sample strategy (bagging / goss), reference: sample_strategy.cpp:16
         from .sample_strategy import create_sample_strategy
-        self.sample_strategy = create_sample_strategy(cfg, N, md)
+        if self._pre_part:
+            # de-correlate per-rank bagging draws (each rank bags its own
+            # shard; identical seeds would tie the masks row-for-row)
+            import dataclasses
+            cfg_bag = dataclasses.replace(
+                cfg, bagging_seed=cfg.bagging_seed
+                + jax.process_index() * 7919)
+            self.sample_strategy = create_sample_strategy(cfg_bag, N, md)
+        else:
+            self.sample_strategy = create_sample_strategy(cfg, N, md)
         self._in_bag_dev = None
 
         self._build_jit_fns()
 
     def _put_rows(self, arr: jnp.ndarray, row_axis: int = 0) -> jnp.ndarray:
-        """Shard `arr` rows over the mesh data axis (no-op when serial)."""
+        """Shard `arr` rows over the mesh data axis (no-op when serial).
+        Pre-partitioned mode assembles the GLOBAL sharded array from each
+        process's local rows (no process ever holds the full data)."""
         if not self.use_dist:
             return arr
+        if self._pre_part:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ..parallel import DATA_AXIS
+            spec = [None] * np.ndim(arr)
+            spec[row_axis] = DATA_AXIS
+            return jax.make_array_from_process_local_data(
+                NamedSharding(self.mesh, P(*spec)), np.asarray(arr))
         from ..parallel import shard_rows
         return shard_rows(self.mesh, arr, row_axis=row_axis)
+
+    def _dist_guards(self, cfg: Config) -> None:
+        """Features whose host paths assume the full dataset on one
+        process fail loudly under pre-partitioned loading (matching the
+        reference's parallel-learner restrictions)."""
+        if self.objective is not None and (
+                self.objective.runs_on_host
+                or self.objective.need_renew_tree_output):
+            log_fatal("pre_partition supports device-side objectives "
+                      "without leaf renewal only (got "
+                      f"{cfg.objective})")
+        if cfg.boosting in ("dart", "rf"):
+            log_fatal("pre_partition does not support boosting="
+                      f"{cfg.boosting} yet")
+
+    def _local_scores(self, k: int) -> np.ndarray:
+        """This process's rows of scores[k] (pre-partitioned mode),
+        padding stripped."""
+        shards = sorted(self.scores.addressable_shards,
+                        key=lambda s: s.index[1].start
+                        if s.index[1].start is not None else 0)
+        local = np.concatenate([np.asarray(sh.data) for sh in shards],
+                               axis=1)
+        return local[k, :self._local_rows]
 
     def _build_jit_fns(self) -> None:
         cfg_static = self.grow_cfg
@@ -647,8 +724,8 @@ class GBDT:
             K = self.num_tree_per_iteration
             g = g.reshape(K, -1)
             h = h.reshape(K, -1)
-            if self.N_pad != self.num_data:
-                pad = ((0, 0), (0, self.N_pad - self.num_data))
+            if self._host_pad != self.num_data:
+                pad = ((0, 0), (0, self._host_pad - self.num_data))
                 g = np.pad(g, pad)
                 h = np.pad(h, pad)
             return (self._put_rows(jnp.asarray(g), row_axis=1),
@@ -691,9 +768,9 @@ class GBDT:
         if self._in_bag_dev is None \
                 or self.sample_strategy.resamples_at(self.iter):
             in_bag = self.sample_strategy.sample(self.iter, None, None)
-            if self.N_pad != self.num_data:
+            if self._host_pad != self.num_data:
                 in_bag = jnp.pad(in_bag,
-                                 (0, self.N_pad - self.num_data))
+                                 (0, self._host_pad - self.num_data))
             self._in_bag_dev = self._put_rows(in_bag, row_axis=0)
 
         # per-iteration feature masks, precomputed host-side (same RNG
@@ -773,8 +850,8 @@ class GBDT:
         else:
             grad = np.asarray(grad, np.float32).reshape(K, -1)
             hess = np.asarray(hess, np.float32).reshape(K, -1)
-            if self.N_pad != self.num_data:
-                pad = ((0, 0), (0, self.N_pad - self.num_data))
+            if self._host_pad != self.num_data:
+                pad = ((0, 0), (0, self._host_pad - self.num_data))
                 grad = np.pad(grad, pad)
                 hess = np.pad(hess, pad)
             g_dev = self._put_rows(jnp.asarray(grad), row_axis=1)
@@ -788,9 +865,9 @@ class GBDT:
             else:
                 g_arg = h_arg = None
             in_bag = strat.sample(self.iter, g_arg, h_arg)
-            if self.N_pad != self.num_data:
+            if self._host_pad != self.num_data:
                 padding = [(0, 0)] * (in_bag.ndim - 1) + \
-                    [(0, self.N_pad - self.num_data)]
+                    [(0, self._host_pad - self.num_data)]
                 in_bag = jnp.pad(in_bag, padding)
             self._in_bag_dev = self._put_rows(in_bag,
                                               row_axis=in_bag.ndim - 1)
@@ -875,8 +952,8 @@ class GBDT:
             leaf = tree.get_leaf_binned(Xb, self)
             add[i % K] += np.asarray(self._tree_output(
                 tree, self._raw_or_none(self.train_set), leaf), np.float32)
-        if self.N_pad != self.num_data:
-            add = np.pad(add, ((0, 0), (0, self.N_pad - self.num_data)))
+        if self._host_pad != self.num_data:
+            add = np.pad(add, ((0, 0), (0, self._host_pad - self.num_data)))
         self.scores = self.scores + self._put_rows(jnp.asarray(add),
                                                    row_axis=1)
         self._models = trees + self._models
@@ -955,8 +1032,8 @@ class GBDT:
             inner_to_real=self._lin_inner2real,
             is_first_tree=is_first)
         dd = np.asarray(delta, np.float32)
-        if self.N_pad != nd:
-            dd = np.pad(dd, (0, self.N_pad - nd))
+        if self._host_pad != nd:
+            dd = np.pad(dd, (0, self._host_pad - nd))
         self.scores = self.scores.at[k].set(
             self.scores[k] + jnp.asarray(dd))
         for vi in range(len(self.valid_sets)):
@@ -1018,6 +1095,13 @@ class GBDT:
             return init_scores
         for k in range(K):
             init_scores[k] = self.objective.boost_from_score(k)
+            if self._pre_part:
+                # the reference averages the per-rank init scores
+                # (GlobalSyncUpByMean, gbdt.cpp:322-325)
+                from jax.experimental import multihost_utils
+                allv = np.asarray(multihost_utils.process_allgather(
+                    np.asarray([init_scores[k]], np.float64)))
+                init_scores[k] = float(allv.mean())
             if abs(init_scores[k]) > _KEPS:
                 self.scores = self.scores.at[k].add(
                     jnp.float32(init_scores[k]))
@@ -1048,6 +1132,10 @@ class GBDT:
         if self.iter <= 0:
             return
         self._stopped = False
+        # the packed/device predict caches key on (start, end, len) and
+        # would collide with the pre-rollback model after retraining
+        self._packed_cache = None
+        self._device_tables_cache = None
         K = self.num_tree_per_iteration
         for k in range(K):
             tree = self.models.pop()
@@ -1058,8 +1146,9 @@ class GBDT:
                 self.train_set.X_binned[:self.num_data], self)
             contrib = np.asarray(self._tree_output(tree, self._raw_or_none(
                 self.train_set), leaf), np.float32)
-            if self.N_pad != self.num_data:
-                contrib = np.pad(contrib, (0, self.N_pad - self.num_data))
+            if self._host_pad != self.num_data:
+                contrib = np.pad(contrib,
+                                 (0, self._host_pad - self.num_data))
             self.scores = self.scores.at[kk].add(
                 -self._put_rows(jnp.asarray(contrib)))
             for vi, ds in enumerate(self.valid_sets):
@@ -1166,8 +1255,18 @@ class GBDT:
         out = []
         for name, metrics in metrics_per_set.items():
             if name == "training":
-                score = np.asarray(
-                    jax.device_get(self.scores))[:, :self.num_data]
+                if self._pre_part:
+                    # each process evaluates its OWN row shard (metrics
+                    # were initialized with the local metadata); the
+                    # reference syncs rank sums for exact global metrics
+                    # (GlobalSum in binary_metric.hpp) — local-shard
+                    # values here, noted in the launcher docs
+                    score = np.stack([
+                        self._local_scores(k)
+                        for k in range(self.num_tree_per_iteration)])
+                else:
+                    score = np.asarray(
+                        jax.device_get(self.scores))[:, :self.num_data]
             else:
                 vi = self.valid_names.index(name)
                 score = np.asarray(jax.device_get(self._valid_scores[vi]))
@@ -1175,6 +1274,19 @@ class GBDT:
             for metric in metrics:
                 for mn, val, hib in metric.eval(s, self.objective):
                     out.append((name, mn, val, hib))
+        if self._pre_part and out:
+            # every rank must see IDENTICAL metric values or metric-driven
+            # callbacks (early_stopping) diverge and deadlock the process
+            # group: sync by averaging the per-rank shard values (the
+            # reference syncs exact sums, GlobalSum in binary_metric.hpp;
+            # the mean of shard metrics is deterministic and
+            # rank-identical, which is the property that matters here)
+            from jax.experimental import multihost_utils
+            vals = np.asarray([v for (_, _, v, _) in out], np.float64)
+            allv = np.asarray(multihost_utils.process_allgather(vals))
+            mean = allv.mean(axis=0)
+            out = [(n_, m_, float(mean[i]), h_)
+                   for i, (n_, m_, _, h_) in enumerate(out)]
         return out
 
     # ------------------------------------------------------------------
@@ -1224,8 +1336,12 @@ class GBDT:
                 on_tpu = False
             if on_tpu:
                 from .predictor import (build_device_tables,
+                                        device_tables_bytes,
                                         predict_margin_device)
                 trees = self.models[start_iteration * K:end * K]
+                if device_tables_bytes(trees, X.shape[1]) > 300_000_000:
+                    trees = None
+            if on_tpu and trees is not None:
                 key = (start_iteration, end, len(self.models))
                 cache = getattr(self, "_device_tables_cache", None)
                 if cache is None or cache[0] != key:

@@ -330,6 +330,16 @@ def build_device_tables(trees, num_class_models: int, F: int):
     return (ohf, thr, dt, bits, P, c, lv, num_class_models)
 
 
+def device_tables_bytes(trees, num_features: int) -> int:
+    """Approximate device memory of build_device_tables' arrays (ohf
+    [T, M_pad, F] + P [T, L_pad, M_pad], both f32) — kept NEXT to the
+    builder so routing budgets track the layout."""
+    Mp = max(max((t.num_leaves - 1 for t in trees), default=1), 1)
+    Mp = int(np.ceil(Mp / 8) * 8)
+    Lp = int(np.ceil(max(t.num_leaves for t in trees) / 8) * 8)
+    return len(trees) * (Mp * num_features + Lp * Mp) * 4
+
+
 def predict_margin_device(trees, num_class_models: int, X,
                           chunk: int = 65536, tables=None) -> "object":
     """Device batch margins — the TPU-native matmul formulation (no

@@ -118,6 +118,15 @@ class GrowConfig(NamedTuple):
     extra_trees: bool = False
     extra_seed: int = 6
 
+    # monotone constraints (monotone_constraints.hpp): "basic" separates
+    # children at the output midpoint; "intermediate" bounds each child by
+    # its sibling's actual output, with bounds refreshed against current
+    # subtree output extrema every wave. monotone_penalty scales the gain
+    # of splits on monotone features by depth
+    # (ComputeMonotoneSplitGainPenalty, :358)
+    monotone_method: str = "basic"
+    monotone_penalty: float = 0.0
+
     @property
     def bundled(self) -> bool:
         return len(self.bundle_col) > 0

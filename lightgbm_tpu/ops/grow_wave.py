@@ -155,6 +155,11 @@ class _WaveState(NamedTuple):
     fidr: jnp.ndarray              # [L] i32 right child's forced-node id
     bfl: jnp.ndarray               # [L] bool: left child's best is forced
     bfr: jnp.ndarray               # [L] bool: right child's best is forced
+    under: jnp.ndarray             # [L, M] i8: 0 = leaf not under node,
+    #   1 = in node's left subtree, 2 = right (monotone intermediate)
+    stale: jnp.ndarray             # [L] bool: bounds moved since the
+    #   leaf's own best was searched (needs an own re-search before it
+    #   may speculate children again)
 
 
 class _SimState(NamedTuple):
@@ -167,6 +172,8 @@ class _SimState(NamedTuple):
     n_leaves: jnp.ndarray          # i32
     n_applied: jnp.ndarray         # i32
     app_leaf: jnp.ndarray          # [K] i32 parent leaf of applied split j
+    mono_done: jnp.ndarray         # bool: a monotone-subtree split already
+    #   landed this wave (intermediate-method serialization)
 
 
 def grow_tree_wave(
@@ -317,10 +324,13 @@ def grow_tree_wave(
     # are psum-aggregated for the (exact-on-voted-features) split search.
     vo = (dist is not None and cfg.n_shards > 1 and cfg.voting_top_k > 0
           and not cfg.bundled)
-    if vo and (has_forced or cfg.has_categorical or cfg.extra_trees):
+    if vo and (has_forced or cfg.has_categorical or cfg.extra_trees
+               or (has_mono and (cfg.monotone_method != "basic"
+                                 or cfg.monotone_penalty > 0.0))):
         raise NotImplementedError(
             "tree_learner=voting does not support forced splits, "
-            "categorical features or extra_trees yet")
+            "categorical features, extra_trees, monotone_penalty or "
+            "monotone_constraints_method=intermediate yet")
     fo = (dist is not None and cfg.n_shards > 1 and not cfg.bundled
           and not vo)
     nsh = cfg.n_shards
@@ -364,7 +374,7 @@ def grow_tree_wave(
     def make_search(meta_use, fmask_use, foffset=0):
       def search(hist2, sum_g, sum_h, count, out, bmin, bmax, sets_row,
                  forced_id=None, used_f=None, fmask_dyn=None,
-                 rand_dyn=None):
+                 rand_dyn=None, mono_pf=None):
         if cfg.bundled:
             # EFB: re-slice the bundle histogram per ORIGINAL feature
             # (Dataset::ConstructHistograms offsets) and reconstruct each
@@ -434,13 +444,14 @@ def grow_tree_wave(
                 hist, sum_g, sum_h, count, out, meta_use, hp, fmask,
                 bmin if has_mono else None,
                 bmax if has_mono else None, ff, fb, cegb_pen=pen,
-                rand_bins=rand_b)
+                rand_bins=rand_b, mono_pen_factor=mono_pf)
         else:
             num = find_best_split(hist, sum_g, sum_h, count, out,
                                   meta_use, hp, fmask,
                                   leaf_min=bmin if has_mono else None,
                                   leaf_max=bmax if has_mono else None,
-                                  cegb_pen=pen, rand_bins=rand_b)
+                                  cegb_pen=pen, rand_bins=rand_b,
+                                  mono_pen_factor=mono_pf)
         nob = jnp.zeros((W,), jnp.uint32)
         if not cfg.has_categorical:
             merged, use_cat, bits = num, jnp.zeros((), bool), nob
@@ -531,20 +542,46 @@ def grow_tree_wave(
         contains = jnp.take(meta.inter_sets.T, bs.feature, axis=0)  # [K, S]
         return psets & contains
 
+    mono_inter = cfg.monotone_method == "intermediate"
+    use_mpen = has_mono and cfg.monotone_penalty > 0.0
+
+    def mpen_factor(depth):
+        """monotone_penalty gain multiplier by leaf depth
+        (ComputeMonotoneSplitGainPenalty, monotone_constraints.hpp:358;
+        kEpsilon = 1e-15)."""
+        pen = cfg.monotone_penalty
+        eps = 1e-15
+        d = depth.astype(jnp.float32)
+        if pen <= 1.0:
+            f = 1.0 - pen / jnp.exp2(d) + eps
+        else:
+            f = 1.0 - jnp.exp2(pen - 1.0 - d) + eps
+        return jnp.where(pen >= d + 1.0, eps, f)
+
     def child_bounds(bs, pmin, pmax):
-        """Children's monotone output bounds after a split (basic method,
-        BasicLeafConstraints::Update, monotone_constraints.hpp:330): on a
-        monotone feature the children are separated at the midpoint of
-        the (clamped) outputs."""
+        """Children's monotone output bounds after a split.
+
+        basic (BasicLeafConstraints::Update, monotone_constraints.hpp:330):
+        children separate at the MIDPOINT of the (clamped) outputs.
+        intermediate (IntermediateLeafConstraints::
+        UpdateConstraintsWithOutputs, :548): each child is bounded by the
+        SIBLING's actual output — less conservative, higher gains. The
+        intermediate bounds are refreshed against current subtree output
+        extrema every wave (refresh_monotone_bounds below), which is the
+        batched fixpoint of the reference's leaves_to_update repair
+        walks (GoUpToFindLeavesToUpdate, :625)."""
         if not has_mono:
             z = jnp.zeros_like(bs.gain)
             return z, z, z, z
         mono_f = meta.monotone[bs.feature]
-        mid = 0.5 * (bs.left_output + bs.right_output)
-        lmax = jnp.where(mono_f > 0, jnp.minimum(pmax, mid), pmax)
-        rmin = jnp.where(mono_f > 0, jnp.maximum(pmin, mid), pmin)
-        lmin = jnp.where(mono_f < 0, jnp.maximum(pmin, mid), pmin)
-        rmax = jnp.where(mono_f < 0, jnp.minimum(pmax, mid), pmax)
+        if mono_inter:
+            lcap, rcap = bs.right_output, bs.left_output
+        else:
+            lcap = rcap = 0.5 * (bs.left_output + bs.right_output)
+        lmax = jnp.where(mono_f > 0, jnp.minimum(pmax, lcap), pmax)
+        rmin = jnp.where(mono_f > 0, jnp.maximum(pmin, rcap), pmin)
+        lmin = jnp.where(mono_f < 0, jnp.maximum(pmin, lcap), pmin)
+        rmax = jnp.where(mono_f < 0, jnp.minimum(pmax, rcap), pmax)
         return lmin, lmax, rmin, rmax
 
     # ---- root
@@ -563,7 +600,9 @@ def grow_tree_wave(
         fmask_dyn=(node_masks(jax.random.fold_in(_bn_base, 0), 1)[0]
                    if bynode else None),
         rand_dyn=(xt_bins(jax.random.fold_in(_xt_base, 0), 1)[0]
-                  if xt else None))
+                  if xt else None),
+        mono_pf=(mpen_factor(jnp.zeros((), jnp.int32)) if use_mpen
+                 else None))
     root_split = root_split._replace(
         gain=jnp.where(max_depth >= 1, root_split.gain, NEG_INF))
     root_forced &= max_depth >= 1
@@ -639,6 +678,8 @@ def grow_tree_wave(
         fidr=jnp.full((L,), -1, jnp.int32),
         bfl=jnp.zeros((L,), bool),
         bfr=jnp.zeros((L,), bool),
+        under=jnp.zeros((L, M), jnp.int8),
+        stale=jnp.zeros((L,), bool),
     )
 
     # wide/categorical/EFB TPU wave path (no feature-count cliff): used
@@ -802,11 +843,16 @@ def grow_tree_wave(
     # ready arrays (~10 tiny ops), so the 254-step sequential chain costs
     # milliseconds; the heavy per-split state updates happen vectorized in
     # wave_step afterwards. gl/gr are the children's (depth-masked) gains.
-    def make_sim(gl, gr):
+    def make_sim(gl, gr, im=None):
+        def blocked(s, p):
+            if im is None:
+                return jnp.bool_(False)
+            return im[p] & s.mono_done
+
         def sim_step(s: _SimState) -> _SimState:
             p = jnp.argmax(s.gain).astype(jnp.int32)
             ok = (s.gain[p] > 0.0) & s.ready[p] & (s.n_leaves < L) \
-                & (s.n_applied < KMAX)
+                & (s.n_applied < KMAX) & ~blocked(s, p)
             r = s.n_leaves                                   # new leaf id
             gain = s.gain.at[p].set(jnp.where(ok, gl[p], s.gain[p]))
             gain = gain.at[jnp.where(ok, r, L)].set(gr[p], mode="drop")
@@ -817,12 +863,14 @@ def grow_tree_wave(
                 n_applied=s.n_applied + ok.astype(jnp.int32),
                 app_leaf=s.app_leaf.at[s.n_applied].set(
                     jnp.where(ok, p, s.app_leaf[s.n_applied])),
+                mono_done=s.mono_done | (ok & (im[p] if im is not None
+                                               else False)),
             )
 
         def sim_cond(s: _SimState):
             p = jnp.argmax(s.gain)
             return (s.gain[p] > 0.0) & s.ready[p] & (s.n_leaves < L) \
-                & (s.n_applied < KMAX)
+                & (s.n_applied < KMAX) & ~blocked(s, p)
 
         return sim_cond, sim_step
 
@@ -845,6 +893,18 @@ def grow_tree_wave(
     def wave_step(st: _WaveState) -> _WaveState:
         j_iota = jnp.arange(KMAX, dtype=jnp.int32)
 
+        if has_mono and mono_inter:
+            # leaves under an existing monotone node (their applications
+            # must serialize — see the batched branch below)
+            node_act0 = jnp.arange(M) < st.tree.num_leaves - 1
+            mono_n0 = jnp.where(
+                node_act0,
+                meta.monotone[st.tree.split_feature].astype(jnp.int32), 0)
+            im_leaf = jnp.any((st.under != 0) & (mono_n0 != 0)[None, :],
+                              axis=1)                         # [L]
+        else:
+            im_leaf = None
+
         # ---- ORDER: which ready leaves split this wave, in what order
         budget = L - st.tree.num_leaves
         if cfg.wave_exact:
@@ -853,13 +913,14 @@ def grow_tree_wave(
             # (sel_key lets pending forced splits outrank normal ones)
             sim_cond, sim_step = make_sim(
                 sel_key(st.bestl.gain, st.bfl, st.fidl),
-                sel_key(st.bestr.gain, st.bfr, st.fidr))
+                sel_key(st.bestr.gain, st.bfr, st.fidr), im=im_leaf)
             sim = jax.lax.while_loop(sim_cond, sim_step, _SimState(
                 gain=sel_key(st.best.gain, st.best_forced, st.leaf_forced),
                 ready=st.ready,
                 n_leaves=st.tree.num_leaves,
                 n_applied=jnp.asarray(0, jnp.int32),
-                app_leaf=jnp.full((KMAX,), -1, jnp.int32)))
+                app_leaf=jnp.full((KMAX,), -1, jnp.int32),
+                mono_done=jnp.bool_(False)))
             napp = sim.n_applied
             app_leaf = sim.app_leaf
         else:
@@ -890,6 +951,19 @@ def grow_tree_wave(
                 else:
                     pressure = 2 * npos >= budget
                 sel &= guard | (j_iota < (npos + 1) // 2) | ~pressure
+            if has_mono and mono_inter:
+                # intermediate bounds derive from SIBLING outputs, which
+                # move as splits land: applying two leaves that share a
+                # monotone ancestor in one wave would use stale bounds
+                # (the reference applies sequentially and repairs
+                # immediately). Serialize: at most ONE split per wave
+                # among leaves under any monotone node.
+                im_split = meta.monotone[st.best.feature] != 0  # [L]
+                ser = im_leaf | im_split
+                sel_mono = sel & ser[rl]
+                first = (jnp.cumsum(sel_mono.astype(jnp.int32))
+                         == 1) & sel_mono
+                sel &= ~sel_mono | first
             napp = jnp.sum(sel).astype(jnp.int32)
             app_leaf = jnp.where(sel, rl.astype(jnp.int32), -1)
         appv = j_iota < napp                                 # [K] bool
@@ -987,8 +1061,21 @@ def grow_tree_wave(
         best_forced2 = upd2(st.best_forced, st.bfl[p_j], st.bfr[p_j])
         feat_used2 = st.feat_used.at[
             jnp.where(appv, bs2.feature, F)].set(True, mode="drop")
+        # subtree membership for monotone-intermediate bound refreshes:
+        # children inherit the parent leaf's mask and add the new node
+        if has_mono and mono_inter:
+            pu = st.under[p_j]                               # [K, M]
+            setcol = (jnp.arange(M, dtype=jnp.int32)[None, :]
+                      == drop_s[:, None])
+            under2 = st.under.at[drop_p].set(
+                jnp.where(setcol, jnp.int8(1), pu), mode="drop")
+            under2 = under2.at[drop_r].set(
+                jnp.where(setcol, jnp.int8(2), pu), mode="drop")
+        else:
+            under2 = st.under
 
         st = st._replace(
+            under=under2,
             tree=t,
             leaf_parent_node=upd2(st.leaf_parent_node, s_j, s_j, jnp.int32),
             leaf_is_left=upd2(st.leaf_is_left,
@@ -1008,11 +1095,48 @@ def grow_tree_wave(
             feat_used=feat_used2,
         )
 
+        if has_mono and mono_inter:
+            # ---- refresh intermediate bounds against CURRENT subtree
+            # output extrema (the batched fixpoint of the reference's
+            # leaves_to_update propagation, GoUpToFindLeavesToUpdate,
+            # monotone_constraints.hpp:625): for an increasing split at
+            # node n, every leaf in left(n) is capped above by
+            # min(outputs over right(n)) and vice versa. Leaves whose
+            # bounds MOVED are re-searched (ready cleared).
+            act = jnp.arange(L) < st.tree.num_leaves
+            o_min = jnp.where(act, st.leaf_output, jnp.inf)[:, None]
+            o_max = jnp.where(act, st.leaf_output, -jnp.inf)[:, None]
+            uL = st.under == 1                               # [L, M]
+            uR = st.under == 2
+            lmax_n = jnp.max(jnp.where(uL, o_max, -jnp.inf), axis=0)
+            rmin_n = jnp.min(jnp.where(uR, o_min, jnp.inf), axis=0)
+            lmin_n = jnp.min(jnp.where(uL, o_min, jnp.inf), axis=0)
+            rmax_n = jnp.max(jnp.where(uR, o_max, -jnp.inf), axis=0)
+            node_act = jnp.arange(M) < st.tree.num_leaves - 1
+            mono_n = jnp.where(node_act,
+                               meta.monotone[st.tree.split_feature]
+                               .astype(jnp.int32), 0)        # [M]
+            capmax = jnp.where(
+                (mono_n > 0)[None, :] & uL, rmin_n[None, :],
+                jnp.where((mono_n < 0)[None, :] & uR, lmin_n[None, :],
+                          jnp.inf))
+            capmin = jnp.where(
+                (mono_n > 0)[None, :] & uR, lmax_n[None, :],
+                jnp.where((mono_n < 0)[None, :] & uL, rmax_n[None, :],
+                          -jnp.inf))
+            new_max = jnp.min(capmax, axis=1)                # [L]
+            new_min = jnp.max(capmin, axis=1)
+            moved = act & ((jnp.abs(new_min - st.leaf_min) > 1e-12)
+                           | (jnp.abs(new_max - st.leaf_max) > 1e-12))
+            st = st._replace(leaf_min=new_min, leaf_max=new_max,
+                             ready=st.ready & ~moved,
+                             stale=st.stale | moved)
+
         # ---- SPECULATE selection: top-K unready frontier leaves by gain
         # (post-apply state: fresh children compete immediately)
         budget2 = L - st.tree.num_leaves
         keyed2 = sel_key(st.best.gain, st.best_forced, st.leaf_forced)
-        cand_gain = jnp.where(st.ready, NEG_INF, keyed2)
+        cand_gain = jnp.where(st.ready | st.stale, NEG_INF, keyed2)
         gains, cand = jax.lax.top_k(cand_gain, KMAX)
         cand = cand.astype(jnp.int32)
         valid = (gains > 0.0) & (j_iota < budget2)
@@ -1160,18 +1284,55 @@ def grow_tree_wave(
             hist_r = jnp.where(smaller_is_left[:, None, None, None],
                                hist_large, hist_small)
 
-            # best splits of both children of every candidate (2K batched)
-            hist_lr = jnp.concatenate([hist_l, hist_r], axis=0)
-            sg_lr = jnp.concatenate([bs.left_sum_g, bs.right_sum_g])
-            sh_lr = jnp.concatenate([bs.left_sum_h, bs.right_sum_h])
-            c_lr = jnp.concatenate([bs.left_count, bs.right_count])
-            o_lr = jnp.concatenate([bs.left_output, bs.right_output])
+            # best splits of both children of every candidate (2K
+            # batched). Monotone-intermediate appends a THIRD block: the
+            # STALE leaves' OWN bests re-searched against their REFRESHED
+            # bounds (the reference re-searches its leaves_to_update the
+            # same way, serial_tree_learner.cpp
+            # FindBestSplitsFromHistograms on the repair list). Stale
+            # leaves are excluded from child speculation this wave — a
+            # changed best would mismatch the speculated child
+            # histograms — and re-enter as normal candidates next wave.
+            research_own = has_mono and mono_inter
+            if research_own:
+                rs_gain = jnp.where(st.stale,
+                                    jnp.maximum(st.best.gain, 0.0),
+                                    NEG_INF)
+                _, rs_i = jax.lax.top_k(rs_gain, KMAX)
+                rs_i = rs_i.astype(jnp.int32)
+                rs_valid = st.stale[rs_i]
+                hist_own = _onehot_gather(
+                    st.hist_cache, jnp.where(rs_valid, rs_i, L)
+                ).reshape((KMAX,) + hshape)
+                own = [hist_own]
+            else:
+                own = []
+            hist_lr = jnp.concatenate([hist_l, hist_r] + own, axis=0)
+
+            def cat3(a, b, o):
+                return jnp.concatenate([a, b] + ([o] if research_own
+                                                 else []))
+
+            sg_lr = cat3(bs.left_sum_g, bs.right_sum_g,
+                         st.leaf_sum_g[rs_i] if research_own else None)
+            sh_lr = cat3(bs.left_sum_h, bs.right_sum_h,
+                         st.leaf_sum_h[rs_i] if research_own else None)
+            c_lr = cat3(bs.left_count, bs.right_count,
+                        st.tree.leaf_count[rs_i].astype(
+                            bs.left_count.dtype) if research_own
+                        else None)
+            o_lr = cat3(bs.left_output, bs.right_output,
+                        st.leaf_output[rs_i] if research_own else None)
             clmin, clmax, crmin, crmax = child_bounds(
                 bs, st.leaf_min[cand], st.leaf_max[cand])
-            bmin_lr = jnp.concatenate([clmin, crmin])
-            bmax_lr = jnp.concatenate([clmax, crmax])
+            bmin_lr = cat3(clmin, crmin,
+                           st.leaf_min[rs_i] if research_own else None)
+            bmax_lr = cat3(clmax, crmax,
+                           st.leaf_max[rs_i] if research_own else None)
             csets = child_sets(bs, st.leaf_sets[cand])       # [K, S]
-            sets_lr = jnp.concatenate([csets, csets], axis=0)
+            sets_lr = jnp.concatenate(
+                [csets, csets] + ([st.leaf_sets[rs_i]] if research_own
+                                  else []), axis=0)
             # children's forced-node ids: candidate's best IS its forced
             # split -> its children continue the forced table (BFS walk)
             if has_forced:
@@ -1180,15 +1341,18 @@ def grow_tree_wave(
                 cfid_c = jnp.clip(cfid, 0, meta.forced.shape[1] - 1)
                 fidl_k = jnp.where(cforced, meta.forced[2, cfid_c], -1)
                 fidr_k = jnp.where(cforced, meta.forced[3, cfid_c], -1)
-                fid_lr = jnp.concatenate([fidl_k, fidr_k])
+                fid_lr = jnp.concatenate(
+                    [fidl_k, fidr_k]
+                    + ([st.leaf_forced[rs_i]] if research_own else []))
             else:
                 fidl_k = fidr_k = jnp.full((KMAX,), -1, jnp.int32)
                 fid_lr = None
+            n_batch = (3 if research_own else 2) * KMAX
             if bynode:
                 bn_masks = node_masks(
                     jax.random.fold_in(_bn_base,
                                        st.tree.num_waves + 1),
-                    2 * KMAX)                             # [2K, F]
+                    n_batch)                              # [nb, F]
             if vo:
                 # ---- PV-Tree vote (voting_parallel_tree_learner.cpp):
                 # rank features by LOCAL gain, psum the votes, aggregate
@@ -1253,16 +1417,23 @@ def grow_tree_wave(
             else:
                 xt_rand = (xt_bins(
                     jax.random.fold_in(_xt_base, st.tree.num_waves + 1),
-                    2 * KMAX) if xt else None)
+                    n_batch) if xt else None)
+                mpf_lr = None
+                if use_mpen:
+                    d_lr = cat3(st.leaf_depth[cand] + 1,
+                                st.leaf_depth[cand] + 1,
+                                st.leaf_depth[rs_i] if research_own
+                                else None)
+                    mpf_lr = mpen_factor(d_lr)
                 s_lr, cat_lr, bits_lr, forced_lr = jax.vmap(
                     lambda h_, sg_, sh_, c_, o_, bn_, bx_, st_, fi_, fd_,
-                    rd_:
+                    rd_, mp_:
                     search_sh(h_, sg_, sh_, c_, o_, bn_, bx_, st_, fi_,
                               used_f=st.feat_used, fmask_dyn=fd_,
-                              rand_dyn=rd_))(
+                              rand_dyn=rd_, mono_pf=mp_))(
                     hist_lr, sg_lr, sh_lr, c_lr, o_lr, bmin_lr, bmax_lr,
                     sets_lr, fid_lr, bn_masks if bynode else None,
-                    xt_rand)
+                    xt_rand, mpf_lr)
             if fo:
                 # map slice-local feature ids to global, then merge the
                 # per-shard bests by SELECTION KEY (a forced split must
@@ -1289,9 +1460,12 @@ def grow_tree_wave(
                 bits_lr = take(allr[2])
                 forced_lr = take(allr[3])
             # depth mask applied at store time so the order simulation can
-            # use stored gains directly
+            # use stored gains directly (the own block re-splits the leaf
+            # itself: its depth gate is depth < max_depth)
             can = st.leaf_depth[cand] + 1 < max_depth
-            can2 = jnp.concatenate([can, can])
+            can2 = cat3(can, can,
+                        st.leaf_depth[rs_i] < max_depth if research_own
+                        else None)
             s_lr = s_lr._replace(
                 gain=jnp.where(can2, s_lr.gain, NEG_INF))
             forced_lr = forced_lr & can2
@@ -1301,7 +1475,7 @@ def grow_tree_wave(
                                arr[cand])
                 return arr.at[cand].set(vv, mode="drop")
 
-            return st._replace(
+            st2 = st._replace(
                 small_hist=_onehot_scatter(
                     st.small_hist, jnp.where(valid, cand, L),
                     hist_small.reshape(KMAX, -1)),
@@ -1309,21 +1483,46 @@ def grow_tree_wave(
                 ready=scat(st.ready, True),
                 bestl=SplitResult(*[scat(a, v[:KMAX])
                                     for a, v in zip(st.bestl, s_lr)]),
-                bestr=SplitResult(*[scat(a, v[KMAX:])
+                bestr=SplitResult(*[scat(a, v[KMAX:2 * KMAX])
                                     for a, v in zip(st.bestr, s_lr)]),
                 catl=scat(st.catl, cat_lr[:KMAX]),
-                catr=scat(st.catr, cat_lr[KMAX:]),
+                catr=scat(st.catr, cat_lr[KMAX:2 * KMAX]),
                 bitsl=scat(st.bitsl, bits_lr[:KMAX], expand=True),
-                bitsr=scat(st.bitsr, bits_lr[KMAX:], expand=True),
+                bitsr=scat(st.bitsr, bits_lr[KMAX:2 * KMAX], expand=True),
                 fidl=scat(st.fidl, fidl_k),
                 fidr=scat(st.fidr, fidr_k),
                 bfl=scat(st.bfl, forced_lr[:KMAX]),
-                bfr=scat(st.bfr, forced_lr[KMAX:]),
+                bfr=scat(st.bfr, forced_lr[KMAX:2 * KMAX]),
             )
+            if research_own:
+                # install the stale leaves' re-searched bests and clear
+                # their staleness (they re-enter as candidates next wave)
+                def scat_rs(arr, v, expand=False):
+                    vv = jnp.where(rs_valid[:, None] if expand
+                                   else rs_valid, v, arr[rs_i])
+                    return arr.at[rs_i].set(vv, mode="drop")
+
+                st2 = st2._replace(
+                    best=SplitResult(*[scat_rs(a, v[2 * KMAX:])
+                                       for a, v in zip(st2.best, s_lr)]),
+                    best_is_cat=scat_rs(st2.best_is_cat,
+                                        cat_lr[2 * KMAX:]),
+                    best_bitset=scat_rs(st2.best_bitset,
+                                        bits_lr[2 * KMAX:], expand=True),
+                    best_forced=scat_rs(st2.best_forced,
+                                        forced_lr[2 * KMAX:]),
+                    stale=st2.stale.at[jnp.where(rs_valid, rs_i, L)].set(
+                        False, mode="drop"),
+                )
+            return st2
 
         st = st._replace(tree=st.tree._replace(
             num_waves=st.tree.num_waves + 1))
-        return jax.lax.cond(n_cand > 0, spec_branch, lambda s: s, st)
+        spec_work = n_cand > 0
+        if has_mono and mono_inter:
+            # stale own re-searches must run even with no candidates
+            spec_work = spec_work | jnp.any(st.stale)
+        return jax.lax.cond(spec_work, spec_branch, lambda s: s, st)
 
     def cond(st: _WaveState):
         keyed = sel_key(st.best.gain, st.best_forced, st.leaf_forced)

@@ -239,6 +239,9 @@ def find_best_split(
     cegb_pen: jnp.ndarray | None = None,      # [F] f32: CEGB gain penalty
     rand_bins: jnp.ndarray | None = None,     # [F] i32: extra_trees random
     #   threshold per feature — only this bin is considered
+    mono_pen_factor: jnp.ndarray | None = None,  # scalar: monotone_penalty
+    #   gain multiplier for splits on monotone features
+    #   (ComputeMonotoneSplitGainPenalty, monotone_constraints.hpp:358)
 ) -> SplitResult:
     """Best numerical split over all features for one leaf.
 
@@ -285,6 +288,15 @@ def find_best_split(
         # serial_tree_learner.cpp FindBestSplitsFromHistograms)
         gain = jnp.where(jnp.isfinite(gain),
                          gain - cegb_pen[None, :, None], gain)
+    if mono_pen_factor is not None and meta.monotone is not None:
+        # monotone_penalty multiplies the FINAL (shifted) gain of splits
+        # on monotone features (serial_tree_learner.cpp:1001-1005);
+        # applied in map space as an affine transform around the shift
+        mono_f = (meta.monotone != 0)[None, :, None]
+        gain = jnp.where(
+            mono_f & jnp.isfinite(gain),
+            (gain - min_gain_shift) * mono_pen_factor + min_gain_shift,
+            gain)
 
     return _pick_best(gain, stats, F, B, min_gain_shift)
 
@@ -336,6 +348,7 @@ def find_best_split_and_forced(
     forced_f: jnp.ndarray, forced_b: jnp.ndarray,
     cegb_pen: jnp.ndarray | None = None,
     rand_bins: jnp.ndarray | None = None,
+    mono_pen_factor: jnp.ndarray | None = None,
 ) -> tuple[SplitResult, SplitResult]:
     """Best numerical split AND the fixed forced-(feature, threshold)
     split from ONE gain-map computation (the map is the expensive part;
@@ -357,6 +370,12 @@ def find_best_split_and_forced(
     if cegb_pen is not None:
         gain_n = jnp.where(jnp.isfinite(gain_n),
                            gain_n - cegb_pen[None, :, None], gain_n)
+    if mono_pen_factor is not None and meta.monotone is not None:
+        mono_f = (meta.monotone != 0)[None, :, None]
+        gain_n = jnp.where(
+            mono_f & jnp.isfinite(gain_n),
+            (gain_n - min_gain_shift) * mono_pen_factor + min_gain_shift,
+            gain_n)
     restrict = ((jnp.arange(F, dtype=jnp.int32) == forced_f)[:, None]
                 & (bins == forced_b))
     gain_f = jnp.where(ok & restrict[None, :, :], gain, NEG_INF)

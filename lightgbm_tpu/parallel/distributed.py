@@ -43,6 +43,12 @@ def init_distributed(machines: str = "",
         entries = [m.strip() for m in machines.split(",") if m.strip()]
         num_machines = max(num_machines, len(entries))
         coordinator_address = entries[0]
+    if coordinator_address is None:
+        # launcher-provided environment (lightgbm_tpu.launch)
+        coordinator_address = os.environ.get("LIGHTGBM_TPU_COORDINATOR")
+    env_n = os.environ.get("LIGHTGBM_TPU_NPROC")
+    if env_n:
+        num_machines = max(num_machines, int(env_n))
     if num_machines <= 1:
         return
     if machine_rank is None:

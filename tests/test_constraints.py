@@ -89,10 +89,12 @@ def test_interaction_constraints(data):
 
 def test_unimplemented_params_fail_loudly(data):
     X, y = data
-    # forced splits and cegb split/coupled penalties are implemented now;
-    # what remains unimplemented must still fail loudly, never silently
-    for bad in (dict(linear_tree=True),
-                dict(cegb_penalty_feature_lazy=[1.0] * X.shape[1])):
+    # linear_tree, forced splits, extra_trees and cegb split/coupled
+    # penalties are implemented now; what remains unimplemented must
+    # still fail loudly, never silently
+    for bad in (dict(cegb_penalty_feature_lazy=[1.0] * X.shape[1]),
+                dict(monotone_constraints=[1] * X.shape[1],
+                     monotone_constraints_method="advanced")):
         with pytest.raises(FatalError):
             lgb.train(dict(objective="regression", verbose=-1, **bad),
                       lgb.Dataset(X, label=y), num_boost_round=1)
@@ -119,3 +121,49 @@ def test_feature_fraction_bynode(data):
     assert len(used) >= 2
     mse = float(np.mean((b1.predict(X) - y) ** 2))
     assert mse < float(np.var(y))
+
+
+def test_monotone_intermediate_enforced_and_less_conservative(data):
+    """monotone_constraints_method=intermediate
+    (IntermediateLeafConstraints, monotone_constraints.hpp:517): bounds
+    come from sibling outputs instead of midpoints — monotonicity still
+    holds, and the looser bounds fit at least as well as basic (the
+    reference's documented reason for the method's existence)."""
+    X, y = data
+    base = dict(objective="regression", num_leaves=31, learning_rate=0.2,
+                verbose=-1, monotone_constraints=[1, -1, 0, 0, 0])
+    fits = {}
+    for method in ("basic", "intermediate"):
+        b = lgb.train({**base, "monotone_constraints_method": method},
+                      lgb.Dataset(X, label=y), num_boost_round=20)
+        assert _monotone_violations(b, X, 0, +1) == 0, method
+        assert _monotone_violations(b, X, 1, -1) == 0, method
+        fits[method] = float(np.mean((y - b.predict(X)) ** 2))
+    # intermediate must not fit WORSE than basic (tolerate tiny noise)
+    assert fits["intermediate"] <= fits["basic"] * 1.05, fits
+
+
+def test_monotone_penalty_discourages_shallow_monotone_splits(data):
+    """monotone_penalty (ComputeMonotoneSplitGainPenalty,
+    monotone_constraints.hpp:358): scales down monotone-feature split
+    gains near the root; a large penalty pushes monotone features out of
+    shallow nodes."""
+    X, y = data
+    base = dict(objective="regression", num_leaves=31, learning_rate=0.2,
+                verbose=-1, monotone_constraints=[1, -1, 0, 0, 0])
+
+    def root_monotone_count(pen):
+        b = lgb.train({**base, "monotone_penalty": pen},
+                      lgb.Dataset(X, label=y), num_boost_round=10)
+        n = 0
+        for t in b._gbdt.models:
+            if t.num_leaves > 1 and int(t.split_feature[0]) in (0, 1):
+                n += 1
+        return n
+
+    assert root_monotone_count(0.0) > root_monotone_count(4.0)
+    # monotonicity still holds under penalty
+    b = lgb.train({**base, "monotone_penalty": 2.0},
+                  lgb.Dataset(X, label=y), num_boost_round=15)
+    assert _monotone_violations(b, X, 0, +1) == 0
+    assert _monotone_violations(b, X, 1, -1) == 0
