@@ -202,7 +202,20 @@ class GBDT:
         N_real = ds.num_data
         self._pre_part = (bool(cfg.pre_partition) and self.use_dist
                           and jax.process_count() > 1)
-        if self.use_dist:
+        # true feature-parallel (feature_parallel_tree_learner.cpp):
+        # every shard holds ALL rows; features partition per tree
+        self._feat_par = (self.use_dist and cfg.tree_learner == "feature")
+        if self._feat_par and self._pre_part:
+            log_fatal("tree_learner=feature requires the full dataset on "
+                      "every machine (pre_partition=true contradicts it)")
+        if self._feat_par:
+            self.mesh = make_data_mesh()
+            self.n_shards = int(self.mesh.devices.size)
+            self.N_pad = N_real
+            self._host_pad = N_real
+            log_info(f"Feature-parallel training over {self.n_shards} "
+                     f"devices (rows replicated, features partitioned)")
+        elif self.use_dist:
             self.mesh = make_data_mesh()
             self.n_shards = int(self.mesh.devices.size)
             if self._pre_part:
@@ -329,6 +342,7 @@ class GBDT:
             extra_seed=int(cfg.extra_seed),
             monotone_method=str(cfg.monotone_constraints_method),
             monotone_penalty=float(cfg.monotone_penalty),
+            feature_parallel=self._feat_par,
         )
 
         # grower selection: "wave" (default via auto) applies batched
@@ -398,6 +412,17 @@ class GBDT:
                           "bundling yet; set enable_bundle=false")
             if self.grower not in ("wave", "wave_exact"):
                 log_warning("tree_learner=voting is implemented by the "
+                            "wave grower; switching tpu_grower to 'wave'")
+                self.grower = "wave"
+        if self._feat_par:
+            # the serial growers psum histograms — with replicated rows
+            # that would overcount n_shards-fold; feature partitioning
+            # lives in the wave grower only
+            if self._use_bundles:
+                log_fatal("tree_learner=feature does not support EFB "
+                          "bundling yet; set enable_bundle=false")
+            if self.grower not in ("wave", "wave_exact"):
+                log_warning("tree_learner=feature is implemented by the "
                             "wave grower; switching tpu_grower to 'wave'")
                 self.grower = "wave"
         # linear trees (reference: linear_tree_learner.cpp wrapping any
@@ -504,9 +529,14 @@ class GBDT:
     def _put_rows(self, arr: jnp.ndarray, row_axis: int = 0) -> jnp.ndarray:
         """Shard `arr` rows over the mesh data axis (no-op when serial).
         Pre-partitioned mode assembles the GLOBAL sharded array from each
-        process's local rows (no process ever holds the full data)."""
+        process's local rows (no process ever holds the full data);
+        feature-parallel mode REPLICATES rows (features partition
+        instead)."""
         if not self.use_dist:
             return arr
+        if self._feat_par:
+            from ..parallel.data_parallel import replicated
+            return replicated(self.mesh, arr)
         if self._pre_part:
             from jax.sharding import NamedSharding, PartitionSpec as P
             from ..parallel import DATA_AXIS
@@ -556,7 +586,8 @@ class GBDT:
         if self.use_dist:
             from ..parallel import build_data_parallel_train_fn
             self._train_tree = build_data_parallel_train_fn(
-                self.mesh, meta, cfg_static, grow_fn=grow_fn)
+                self.mesh, meta, cfg_static, grow_fn=grow_fn,
+                replicate_rows=self._feat_par)
         else:
             cegb_on = self._cegb_on
 

@@ -127,6 +127,11 @@ class GrowConfig(NamedTuple):
     monotone_method: str = "basic"
     monotone_penalty: float = 0.0
 
+    # feature-parallel learner (feature_parallel_tree_learner.cpp:23-84):
+    # every shard holds ALL rows; features partition per shard; only the
+    # tiny split records cross the wire (SyncUpGlobalBestSplit)
+    feature_parallel: bool = False
+
     @property
     def bundled(self) -> bool:
         return len(self.bundle_col) > 0

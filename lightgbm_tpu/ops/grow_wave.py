@@ -66,13 +66,15 @@ from .categorical import find_best_split_categorical
 
 def _wave_buckets(L: int, kcap: int = 128) -> list[int]:
     """Static slot-kernel sizes; the smallest bucket >= wave size is used.
-    MXU cost of a slot pass scales linearly with K (measured ~1.1 ms per
-    slot-unit at B=256/N=4M on v5e), so the buckets are exact powers of
-    two: a wave of size K pays for at most 2K slots. `kcap` bounds the
-    widest wave (the megakernel's [K, C, 32, B] VMEM-resident output must
-    stay inside scoped VMEM, ~16 MB on v5e)."""
+    MXU cost of a slot pass scales linearly with K beyond ~32 (measured
+    ~0.22 ms/slot at B=64/C=3/N=4M on v5e), so the ladder uses 1.5x steps
+    in the expensive range — a wave of size K pays at most 1.5K slots
+    there (pure pow-2 would pay 2K). `kcap` bounds the widest wave (the
+    kernel's [HB*C*K, F*LO] f32 output block must stay inside scoped
+    VMEM)."""
     kmax = min(kcap, max(L - 1, 1))
-    return [k for k in (1, 2, 4, 8, 16, 32, 64) if k < kmax] + [kmax]
+    ladder = (1, 2, 4, 8, 16, 32, 48, 64, 96)
+    return [k for k in ladder if k < kmax] + [kmax]
 
 
 def _oh_dot(oh: jnp.ndarray, flat: jnp.ndarray) -> jnp.ndarray:
@@ -206,7 +208,8 @@ def grow_tree_wave(
     # categorical, narrow enough to hold all features in one kernel block)
     from .histogram import _use_pallas
     use_mega = (_use_pallas(X_t, B) and not cfg.bundled
-                and not cfg.has_categorical and X_t.shape[0] <= 32)
+                and not cfg.has_categorical and X_t.shape[0] <= 32
+                and not cfg.feature_parallel)
     if use_mega:
         # the megakernel's [HB*C*K, 32*LO] f32 output block lives in VMEM
         # for the whole grid; bound K so it stays within scoped VMEM.
@@ -222,11 +225,15 @@ def grow_tree_wave(
         buckets = _wave_buckets(L)
     KMAX = buckets[-1]
 
+    # feature-parallel holds the FULL data on every shard: row-statistic
+    # reductions are local (a psum would overcount n_shards-fold)
+    _row_local = dist is None or cfg.feature_parallel
+
     def psum(x):
-        return dist.psum(x) if dist is not None else x
+        return x if _row_local else dist.psum(x)
 
     def pmax(x):
-        return dist.pmax(x) if dist is not None else x
+        return x if _row_local else dist.pmax(x)
 
     g = grad.astype(jnp.float32) * in_bag
     h = hess.astype(jnp.float32) * in_bag
@@ -331,10 +338,18 @@ def grow_tree_wave(
             "tree_learner=voting does not support forced splits, "
             "categorical features, extra_trees, monotone_penalty or "
             "monotone_constraints_method=intermediate yet")
+    # feature-parallel (feature_parallel_tree_learner.cpp:23-84): every
+    # shard holds ALL rows, features partition across shards — histograms
+    # are built directly on the local feature slice with NO histogram
+    # collective at all; only the split records merge (the fo machinery's
+    # allgather). fo (data-parallel reduce-scatter ownership) and fp are
+    # mutually exclusive.
+    fp = (dist is not None and cfg.n_shards > 1 and cfg.feature_parallel
+          and not cfg.bundled and not vo)
     fo = (dist is not None and cfg.n_shards > 1 and not cfg.bundled
-          and not vo)
+          and not vo and not fp)
     nsh = cfg.n_shards
-    if fo:
+    if fo or fp:
         from ..utils import round_up
         Fh_pad = round_up(F, nsh)
         Fs = Fh_pad // nsh
@@ -480,7 +495,15 @@ def grow_tree_wave(
       return search
 
     search = make_search(meta, feature_mask)
-    search_sh = make_search(meta_sh, fmask_sh, foff) if fo else search
+    search_sh = make_search(meta_sh, fmask_sh, foff) if (fo or fp) \
+        else search
+
+    if fp:
+        # each shard histograms ONLY its feature slice (over all rows)
+        X_pad_fp = jnp.pad(X_t, ((0, Fh_pad - F), (0, 0)))
+        X_hist = jax.lax.dynamic_slice_in_dim(X_pad_fp, foff, Fs, 0)
+    else:
+        X_hist = X_t
 
     # per-node column sampling (ColSampler::GetByNode, col_sampler.hpp:208)
     bynode = cfg.feature_fraction_bynode < 1.0
@@ -589,24 +612,49 @@ def grow_tree_wave(
         -jnp.sign(root_g) * jnp.maximum(jnp.abs(root_g) - hp.lambda_l1, 0.0)
         / (root_h + hp.lambda_l2), jnp.float32)
 
-    hist_root_local = build_histogram(X_t, vals0, B, cfg.rows_per_chunk)
+    # feature-parallel builds the root on its feature slice only (the
+    # whole point of the learner: 1/n of the histogram work per shard)
+    hist_root_local = build_histogram(X_hist if fp else X_t, vals0, B,
+                                      cfg.rows_per_chunk)
     hist_root = psum(hist_root_local)
     root_fid = jnp.asarray(0 if has_forced else -1, jnp.int32)
     used0 = (cegb_used if has_cegb else jnp.zeros((F,), bool))
-    root_split, root_is_cat, root_bitset, root_forced = search(
-        hist_root, root_g, root_h, root_c, root_out,
-        jnp.float32(-jnp.inf), jnp.float32(jnp.inf),
-        jnp.ones((S,), bool), forced_id=root_fid, used_f=used0,
+    root_kwargs = dict(
+        forced_id=root_fid, used_f=used0,
         fmask_dyn=(node_masks(jax.random.fold_in(_bn_base, 0), 1)[0]
                    if bynode else None),
         rand_dyn=(xt_bins(jax.random.fold_in(_xt_base, 0), 1)[0]
                   if xt else None),
         mono_pf=(mpen_factor(jnp.zeros((), jnp.int32)) if use_mpen
                  else None))
+    root_search_fn = search_sh if fp else search
+    root_split, root_is_cat, root_bitset, root_forced = root_search_fn(
+        hist_root, root_g, root_h, root_c, root_out,
+        jnp.float32(-jnp.inf), jnp.float32(jnp.inf),
+        jnp.ones((S,), bool), **root_kwargs)
+    if fp:
+        # merge the per-shard root records (SyncUpGlobalBestSplit)
+        root_split = root_split._replace(feature=root_split.feature + foff)
+        rec = (tuple(root_split), root_is_cat, root_bitset, root_forced)
+        allr = jax.tree.map(
+            lambda a: dist.all_gather(a[None], axis=0, tiled=False), rec)
+        rkey = allr[0][0][:, 0]
+        if has_forced:
+            rkey = jnp.where(allr[3][:, 0], 2e18, rkey)
+        rpick = jnp.argmax(rkey)
+        root_split = SplitResult(*[a[rpick, 0] for a in allr[0]])
+        root_is_cat = allr[1][rpick, 0]
+        root_bitset = allr[2][rpick, 0]
+        root_forced = allr[3][rpick, 0]
     root_split = root_split._replace(
         gain=jnp.where(max_depth >= 1, root_split.gain, NEG_INF))
     root_forced &= max_depth >= 1
-    if fo:
+    if fp:
+        # the cache IS the local slice already
+        pads = [(0, 0)] * hist_root.ndim
+        pads[1] = (0, Fs - hist_root.shape[1])
+        hist_cache0 = jnp.pad(hist_root, pads)
+    elif fo:
         # the per-shard caches hold this shard's feature slice only
         pads = [(0, 0)] * hist_root.ndim
         pads[1] = (0, Fh_pad - hist_root.shape[1])
@@ -788,7 +836,7 @@ def grow_tree_wave(
 
     def make_hist_branch(K):
         def branch(slot_small):
-            hist = build_histogram_slots(X_t, vals0, slot_small, K, B,
+            hist = build_histogram_slots(X_hist, vals0, slot_small, K, B,
                                          cfg.rows_per_chunk)
             if K < KMAX:
                 hist = jnp.pad(hist, ((0, KMAX - K), (0, 0), (0, 0), (0, 0)))
@@ -1273,6 +1321,9 @@ def grow_tree_wave(
                     jnp.pad(hist_local, pads), axis=2)
             elif vo:
                 hist_small = hist_local     # voting: caches stay local
+            elif fp:
+                # full rows local: the feature-slice histogram IS global
+                hist_small = hist_local
             else:
                 hist_small = psum(hist_local)
             hist_parent = _onehot_gather(
@@ -1434,7 +1485,7 @@ def grow_tree_wave(
                     hist_lr, sg_lr, sh_lr, c_lr, o_lr, bmin_lr, bmax_lr,
                     sets_lr, fid_lr, bn_masks if bynode else None,
                     xt_rand, mpf_lr)
-            if fo:
+            if fo or fp:
                 # map slice-local feature ids to global, then merge the
                 # per-shard bests by SELECTION KEY (a forced split must
                 # beat other shards' normal bests regardless of gain;

@@ -79,19 +79,21 @@ def _compute_dims(num_bins: int):
 
 
 def _feat_chunk(F: int, LO: int, rows: int) -> int:
-    """Features per one-hot chunk: the [Fc*LO, R] bf16 scratch targets
-    ~4 MB and the [rows, Fc*LO] f32 output block ~3.3 MB; chunk starts
-    stay 128-lane aligned, and the chunk count is balanced so the last
-    chunk carries no dead features (28 features -> 2x14, not 16+12pad:
-    padded features cost real MXU MACs)."""
+    """Features per one-hot chunk. Every chunk costs one matmul whose
+    latency dominates at small K (measured ~2 us/block on v5e), so the
+    chunk count is the MINIMUM satisfying the VMEM budgets: the
+    [Fc*LO, R] bf16 one-hot value stays <= 8 MB (<= 2048 lanes at
+    R=2048) and the [rows, Fc*LO] f32 output block <= ~3.4 MB. Chunks
+    are balanced (28 features -> 1x28 when it fits, else 2x14 — never
+    16+12pad: padded features cost real MXU MACs) and 128-lane aligned."""
     align = max(128 // LO, 1)
-    fc = max(1024 // LO, align)
-    while rows * fc * LO * 4 > 3_400_000 and fc > align:
-        fc //= 2
-    if F <= fc:
-        return _round_up(F, align)
-    n_chunks = -(-F // fc)
-    return _round_up(-(-F // n_chunks), align)
+    n_chunks = 1
+    while True:
+        fc = _round_up(-(-F // n_chunks), align)
+        if (fc * LO <= 2048 and rows * fc * LO * 4 <= 3_400_000) \
+                or fc <= align:
+            return fc
+        n_chunks += 1
 
 
 def _accum_chunk(xx, W, out_ref, col0, *, C, K, LO, HB, quantized):

@@ -40,7 +40,8 @@ def pad_rows_to(n: int, num_shards: int, multiple: int = 8) -> int:
 def build_data_parallel_train_fn(mesh: jax.sharding.Mesh,
                                  meta: FeatureMeta,
                                  cfg: GrowConfig,
-                                 grow_fn=grow_tree):
+                                 grow_fn=grow_tree,
+                                 replicate_rows: bool = False):
     """Returns jit(train_step) with the same signature as the serial
     `_train_tree` in models/gbdt.py:
 
@@ -65,11 +66,14 @@ def build_data_parallel_train_fn(mesh: jax.sharding.Mesh,
                                                  leaf_of_row)
         return tree, leaf_of_row, new_scores
 
-    row = P(DATA_AXIS)
+    # feature-parallel (replicate_rows): every shard sees ALL rows and
+    # works a feature slice inside the grower; outputs are replicated
+    row = P() if replicate_rows else P(DATA_AXIS)
     rep = P()
     sharded = jax.shard_map(
         step, mesh=mesh,
-        in_specs=(P(None, DATA_AXIS), row, row, row, row, rep, rep, rep),
+        in_specs=((P() if replicate_rows else P(None, DATA_AXIS)),
+                  row, row, row, row, rep, rep, rep),
         out_specs=(rep, row, row),
         check_vma=False)
     return jax.jit(sharded)

@@ -110,3 +110,32 @@ def test_voting_narrow_topk_still_learns(eight_devices):
     bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=10)
     acc = np.mean((bst.predict(X) > 0.5) == (y > 0.5))
     assert acc > 0.8
+
+
+def test_feature_parallel_matches_serial(eight_devices):
+    """tree_learner=feature (feature_parallel_tree_learner.cpp:23-84):
+    all rows on every shard, features partitioned, only split records
+    cross the wire. Histograms are bitwise the serial ones, so the tree
+    STRUCTURE must match serial training exactly."""
+    X, y = _make_binary(n=1500, f=16, seed=11)
+    params = dict(objective="binary", num_leaves=15, learning_rate=0.1,
+                  min_data_in_leaf=5, verbosity=-1)
+    b_serial = lgb.train(params, lgb.Dataset(X, y), num_boost_round=5)
+    b_fp = lgb.train({**params, "tree_learner": "feature"},
+                     lgb.Dataset(X, y), num_boost_round=5)
+    for ts, tf in zip(b_serial._gbdt.models, b_fp._gbdt.models):
+        assert ts.num_leaves == tf.num_leaves
+        np.testing.assert_array_equal(ts.split_feature, tf.split_feature)
+        np.testing.assert_array_equal(
+            np.asarray(ts.threshold_in_bin),
+            np.asarray(tf.threshold_in_bin))
+    np.testing.assert_allclose(b_serial.predict(X), b_fp.predict(X),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_feature_parallel_quality(eight_devices):
+    X, y = _make_binary(n=2000, f=24, seed=12)
+    b = lgb.train(dict(objective="binary", num_leaves=31, verbosity=-1,
+                       tree_learner="feature", min_data_in_leaf=5),
+                  lgb.Dataset(X, y), num_boost_round=15)
+    assert np.mean((b.predict(X) > 0.5) == (y > 0.5)) > 0.9
