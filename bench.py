@@ -60,6 +60,12 @@ def main() -> None:
         return jnp.sum(b._gbdt.scores)
 
     booster = lgb.Booster(params=params, train_set=ds)
+    # two warmup chunks: the first pays jit compilation, the second the
+    # one-time dispatch/steady-state costs (first-call executable load on
+    # the tunneled runtime) — the timed window then measures the
+    # steady-state throughput a long training run sees.
+    booster.update_batch(iters)
+    barrier(booster)
     booster.update_batch(iters)
     barrier(booster)
 
@@ -68,7 +74,7 @@ def main() -> None:
     barrier(booster)
     dt = time.perf_counter() - t0
 
-    # train AUC over the 2x iters trained so far: guards against "fast but
+    # train AUC over the 3x iters trained so far: guards against "fast but
     # wrong" — a kernel change that hurt split quality would show up here.
     # Uses the framework's own tie-aware AUCMetric so the gate and the
     # trainer's metric can never diverge.

@@ -650,13 +650,19 @@ class Booster:
         done = 0
         chunks_done = 0
         if self._gbdt.can_batch_iters(n):
+            n_chunks = n // chunk
             while n - done >= chunk:
                 self._gbdt.train_iters_batched(chunk)
                 done += chunk
                 chunks_done += 1
                 # amortized no-more-splits check (one sync) at power-of-2
-                # chunk counts, mirroring train_one_iter's policy
-                if (chunks_done & (chunks_done - 1)) == 0 \
+                # chunk counts, mirroring train_one_iter's policy. The
+                # FIRST chunk is exempt (a 32-iteration run cannot
+                # plausibly exhaust splits, and the sync costs a full
+                # device drain on a tunneled chip); so is the last chunk,
+                # whose trees are already queued either way.
+                if chunks_done > 1 and chunks_done < n_chunks \
+                        and (chunks_done & (chunks_done - 1)) == 0 \
                         and self._gbdt._check_stopped():
                     self._gbdt._stopped = True
                     return

@@ -1,0 +1,80 @@
+"""Per-LINE device-time breakdown: lines in an xplane are non-overlapping
+event sequences, so summing within one line gives true busy time for that
+line. Prints each TPU plane line's total and its top ops.
+
+Usage: python scripts/profile_lines.py [rows] [iters] [max_bin]
+"""
+import collections
+import glob
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+rows = int(sys.argv[1]) if len(sys.argv) > 1 else 4_000_000
+iters = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+max_bin = int(sys.argv[3]) if len(sys.argv) > 3 else 63
+
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(42)
+cols = int(os.environ.get("BENCH_COLS", "28"))
+X = rng.normal(size=(rows, cols)).astype(np.float32)
+w = rng.normal(size=cols)
+y = (X @ w + rng.normal(scale=0.5, size=rows) > 0).astype(np.float32)
+
+params = dict(objective="binary", num_leaves=255, max_bin=max_bin,
+              learning_rate=0.1, min_data_in_leaf=20, verbose=-1,
+              bagging_freq=0)
+ds = lgb.Dataset(X, label=y)
+booster = lgb.Booster(params=params, train_set=ds)
+booster.update_batch(iters)
+jax.device_get(jnp.sum(booster._gbdt.scores))
+
+t0 = time.perf_counter()
+booster.update_batch(iters)
+jax.device_get(jnp.sum(booster._gbdt.scores))
+wall_raw = time.perf_counter() - t0
+
+tmp = tempfile.mkdtemp(prefix="jaxprof_")
+t0 = time.perf_counter()
+jax.profiler.start_trace(tmp)
+booster.update_batch(iters)
+jax.device_get(jnp.sum(booster._gbdt.scores))
+jax.profiler.stop_trace()
+wall = time.perf_counter() - t0
+print(f"wall untraced: {wall_raw/iters*1e3:.1f} ms/tree | "
+      f"traced: {wall/iters*1e3:.1f} ms/tree")
+
+pbs = glob.glob(os.path.join(tmp, "**", "*.xplane.pb"), recursive=True)
+from jax.profiler import ProfileData
+
+for pb in pbs:
+    pd = ProfileData.from_serialized_xspace(open(pb, "rb").read())
+    for plane in pd.planes:
+        if "TPU" not in plane.name:
+            continue
+        for line in plane.lines:
+            agg = collections.Counter()
+            cnt = collections.Counter()
+            tot = 0
+            for ev in line.events:
+                agg[ev.name[:70]] += ev.duration_ns
+                cnt[ev.name[:70]] += 1
+                tot += ev.duration_ns
+            if tot < 1e6:
+                continue
+            print(f"\n--- line '{line.name}' total {tot/1e6/iters:.1f} "
+                  f"ms/tree ---")
+            for name, ns in agg.most_common(25):
+                if ns / 1e6 / iters < 0.3:
+                    break
+                print(f"{ns/1e6/iters:9.2f} ms/tree x{cnt[name]/iters:<6.1f}"
+                      f" {name}")
