@@ -34,7 +34,8 @@ params = dict(objective="binary", num_leaves=255, max_bin=max_bin,
               bagging_freq=0)
 ds = lgb.Dataset(X, label=y)
 booster = lgb.Booster(params=params, train_set=ds)
-booster.update_batch(4)
+warmup = int(os.environ.get("PROFILE_WARMUP", "4"))
+booster.update_batch(warmup)
 jax.device_get(jnp.sum(booster._gbdt.scores))
 
 tmp = tempfile.mkdtemp(prefix="jaxprof_")
