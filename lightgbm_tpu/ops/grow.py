@@ -39,7 +39,7 @@ from ..models.tree import MISSING_NAN, MISSING_ZERO
 from .categorical import CatConfig, find_best_split_categorical
 from .histogram import build_histogram
 from .split import (NEG_INF, FeatureMeta, SplitHyperParams, SplitResult,
-                    find_best_split)
+                    find_best_split, synth_count_channel)
 
 
 class GrowConfig(NamedTuple):
@@ -251,30 +251,35 @@ def grow_tree(
 
     g = grad.astype(jnp.float32) * in_bag
     h = hess.astype(jnp.float32) * in_bag
-    # count channel = in-bag ROW indicator (GOSS amplification rides only
-    # on g/h in the reference, goss.hpp; counts stay true row counts)
+    # in-bag ROW indicator for the exact root count (GOSS amplification
+    # rides only on g/h in the reference, goss.hpp)
     cnt_row = (in_bag > 0).astype(jnp.float32)
 
     def hist_for_children(leaf_l, leaf_r, leaf_of_row):
-        """One fused pass: histograms for both children ((g,h,c) x (l,r)).
+        """One fused pass: histograms for both children ((g,h) x (l,r)).
 
         g/h already carry the in_bag multiplier (out-of-bag rows are 0, GOSS
         rows amplified ONCE) — the leaf masks must stay plain indicators or
-        the amplification would square."""
+        the amplification would square. Histogram entries are (grad, hess)
+        only, matching the reference layout (bin.h:40); counts are
+        synthesized at search time via cnt_factor."""
         ind_l = (leaf_of_row == leaf_l).astype(jnp.float32)
         ind_r = (leaf_of_row == leaf_r).astype(jnp.float32)
-        vals = jnp.stack([g * ind_l, h * ind_l, cnt_row * ind_l,
-                          g * ind_r, h * ind_r, cnt_row * ind_r],
-                         axis=0)                                 # [6, N]
-        hist6 = build_histogram(X_t, vals, B, cfg.rows_per_chunk)
-        hist6 = psum(hist6)
-        return hist6[:3], hist6[3:]
+        vals = jnp.stack([g * ind_l, h * ind_l,
+                          g * ind_r, h * ind_r],
+                         axis=0)                                 # [4, N]
+        hist4 = build_histogram(X_t, vals, B, cfg.rows_per_chunk)
+        hist4 = psum(hist4)
+        return hist4[:2], hist4[2:]
 
     W = cfg.cat_words
 
     def search(hist, sum_g, sum_h, count, out):
         """Best split over numerical + categorical features
-        (FindBestThreshold dispatch, feature_histogram.hpp:166-178)."""
+        (FindBestThreshold dispatch, feature_histogram.hpp:166-178).
+        `hist` arrives [2, F, B]; the count channel is synthesized via the
+        reference's cnt_factor (feature_histogram.hpp:529,844)."""
+        hist = synth_count_channel(hist, count, sum_h)
         num = find_best_split(hist, sum_g, sum_h, count, out, meta, hp,
                               feature_mask)
         if not cfg.has_categorical:
@@ -295,7 +300,7 @@ def grow_tree(
         -jnp.sign(root_g) * jnp.maximum(jnp.abs(root_g) - hp.lambda_l1, 0.0)
         / (root_h + hp.lambda_l2), jnp.float32)
 
-    vals0 = jnp.stack([g, h, cnt_row], axis=0)
+    vals0 = jnp.stack([g, h], axis=0)
     hist_root = psum(build_histogram(X_t, vals0, B, cfg.rows_per_chunk))
     root_split, root_is_cat, root_bitset = search(
         hist_root, root_g, root_h, root_c, root_out)

@@ -38,7 +38,8 @@ import jax.numpy as jnp
 from .grow import DeviceTree, GrowConfig, _empty_split_cache, _set_cache
 from .histogram import build_histogram
 from ..models.tree import MISSING_NAN, MISSING_ZERO
-from .split import (NEG_INF, FeatureMeta, SplitResult, find_best_split)
+from .split import (NEG_INF, FeatureMeta, SplitResult, find_best_split,
+                    synth_count_channel)
 from .categorical import find_best_split_categorical
 
 _MIN_BUCKET = 256
@@ -109,6 +110,9 @@ def grow_tree_fast(
     cnt_row = (in_bag > 0).astype(jnp.float32)
 
     def search(hist, sum_g, sum_h, count, out):
+        # hist arrives [2, F, B] (grad, hess); counts synthesize via the
+        # reference's cnt_factor (feature_histogram.hpp:529,844)
+        hist = synth_count_channel(hist, count, sum_h)
         num = find_best_split(hist, sum_g, sum_h, count, out, meta, hp,
                               feature_mask)
         if not cfg.has_categorical:
@@ -129,7 +133,7 @@ def grow_tree_fast(
         -jnp.sign(root_g) * jnp.maximum(jnp.abs(root_g) - hp.lambda_l1, 0.0)
         / (root_h + hp.lambda_l2), jnp.float32)
 
-    vals0 = jnp.stack([g, h, cnt_row], axis=0)
+    vals0 = jnp.stack([g, h], axis=0)
     hist_root = psum(build_histogram(X_t, vals0, B, cfg.rows_per_chunk))
     root_split, root_is_cat, root_bitset = search(
         hist_root, root_g, root_h, root_c, root_out)
@@ -159,7 +163,7 @@ def grow_tree_fast(
         split_cat_bitset=jnp.zeros((M, W), jnp.uint32),
         num_waves=jnp.asarray(0, jnp.int32),
     )
-    hist_cache = jnp.zeros((L, 3, F, B), jnp.float32).at[0].set(hist_root)
+    hist_cache = jnp.zeros((L, 2, F, B), jnp.float32).at[0].set(hist_root)
     state = _FastState(
         tree=tree,
         order=jnp.arange(N, dtype=jnp.int32),
@@ -183,7 +187,7 @@ def grow_tree_fast(
     def make_branch(S: int):
         """Bucket-S branch: partition leaf p's rows + smaller-child hist.
 
-        Returns (order [N], n_left_local i32, hist_small [3, F, B]).
+        Returns (order [N], n_left_local i32, hist_small [2, F, B]).
         """
 
         def branch(args):
@@ -226,11 +230,9 @@ def grow_tree_fast(
             # smaller-ness is decided by the caller via left/right counts
             in_small = jnp.where(smaller_is_left, go_left, go_right)
             m = in_small.astype(jnp.float32) * in_bag[idx]
-            mc = in_small.astype(jnp.float32) * cnt_row[idx]
             Xg = jnp.take(X_t, idx, axis=1)                          # [F, S]
             vals = jnp.stack([grad[idx].astype(jnp.float32) * m,
-                              hess[idx].astype(jnp.float32) * m,
-                              mc], axis=0)
+                              hess[idx].astype(jnp.float32) * m], axis=0)
             hist_small = build_histogram(Xg, vals, B, cfg.rows_per_chunk)
             return order, n_left, hist_small
 

@@ -217,7 +217,7 @@ def grow_tree_wave(
         # budget must use that padded size, not cfg.num_bins_padded.
         from .histogram_pallas import _compute_dims
         B_lane = _compute_dims(B)[0]
-        C_stat = 2 if quant else 3
+        C_stat = 2          # (grad, hess) in both float and quantized mode
         kcap = 3_400_000 // (C_stat * 32 * B_lane * 4)
         kcap = max(1 << (kcap.bit_length() - 1), 1) if kcap >= 1 else 1
         buckets = _wave_buckets(L, min(kcap, 128))
@@ -246,15 +246,16 @@ def grow_tree_wave(
     root_h = psum(jnp.sum(h))
     root_c = psum(jnp.sum(cnt_row))
 
-    # Histograms carry (grad, hess, count) in float mode: the count
-    # channel accumulates the 0/1 in-bag indicator, which is exact in the
-    # bf16 contraction — min_data_in_leaf decisions and leaf_count
-    # metadata are exact, matching the serial growers (the reference only
-    # approximates counts when weights exist, feature_histogram.hpp:877).
-    # QUANTIZED mode carries (grad, hess) only and synthesizes counts from
-    # hessians with the parent count/hessian ratio — exactly the
-    # reference's int-histogram behavior (FindBestThresholdSequentiallyInt
-    # uses cnt_factor everywhere, feature_histogram.hpp:1077-1324).
+    # Histograms carry (grad, hess) ONLY — the reference's own entry
+    # layout (bin.h:40: kHistEntrySize = 2 doubles). Per-bin counts are
+    # synthesized at search time from hessians with the parent
+    # count/hessian ratio, exactly the reference's cnt_factor behavior in
+    # BOTH its float path (FindBestThresholdSequentially,
+    # feature_histogram.hpp:529,844: RoundInt(hess * cnt_factor)) and its
+    # int path (FindBestThresholdSequentiallyInt, :1077-1324). Dropping
+    # the third exact-count channel cuts the MXU contraction cost and the
+    # histogram caches by a third; root counts stay exact (computed from
+    # in_bag directly) and leaf_count metadata descends via split records.
     if quant:
         # GradientDiscretizer::DiscretizeGradients semantics
         # (gradient_discretizer.cpp:72-162): per-tree scales synced by max
@@ -279,7 +280,7 @@ def grow_tree_wave(
         vals0 = jnp.stack([g8, h8], axis=0)              # [2, N] int8
         ch_scale = jnp.stack([g_scale, h_scale])[:, None, None]
     else:
-        vals0 = jnp.stack([g, h, cnt_row], axis=0)       # [3, N] f32
+        vals0 = jnp.stack([g, h], axis=0)                # [2, N] f32
         ch_scale = None
     C = vals0.shape[0]
 
@@ -290,13 +291,11 @@ def grow_tree_wave(
         return histc
 
     def with_counts(histc, count, sum_h):
-        """[C, F, B] descaled histogram -> [3, F, B] with a count channel
-        (quantized mode synthesizes counts via the reference's cnt_factor,
-        feature_histogram.hpp:1077; float mode already carries them)."""
-        if not quant:
-            return histc
-        cntf = count / jnp.maximum(sum_h, 1e-12)
-        return jnp.concatenate([histc, histc[1:2] * cntf], axis=0)
+        """[2, F, B] descaled histogram -> [3, F, B] with the count
+        channel synthesized via the reference's cnt_factor
+        (split.synth_count_channel; feature_histogram.hpp:529,844,1077)."""
+        from .split import synth_count_channel
+        return synth_count_channel(histc, count, sum_h)
 
     has_mono = meta.monotone is not None
     has_inter = meta.inter_sets is not None
@@ -1328,7 +1327,7 @@ def grow_tree_wave(
                 hist_small = psum(hist_local)
             hist_parent = _onehot_gather(
                 st.hist_cache, jnp.where(valid, cand, L)
-            ).reshape((KMAX,) + hshape)                      # [K, 3, F, B]
+            ).reshape((KMAX,) + hshape)                      # [K, C, F, B]
             hist_large = hist_parent - hist_small
             hist_l = jnp.where(smaller_is_left[:, None, None, None],
                                hist_small, hist_large)
@@ -1414,10 +1413,7 @@ def grow_tree_wave(
                 hist_v = to_f32(hist_lr)                  # [2K, C, F, B]
                 loc_g = jnp.sum(hist_v[:, 0, 0, :], axis=-1)
                 loc_h = jnp.sum(hist_v[:, 1, 0, :], axis=-1)
-                if quant:
-                    loc_c = loc_h * (c_lr / jnp.maximum(sh_lr, 1e-12))
-                else:
-                    loc_c = jnp.sum(hist_v[:, 2, 0, :], axis=-1)
+                loc_c = loc_h * (c_lr / jnp.maximum(sh_lr, 1e-12))
                 hist3 = jax.vmap(with_counts)(hist_v, c_lr, sh_lr)
                 if bynode:
                     fm_vote = (bn_masks if feature_mask is None

@@ -99,6 +99,17 @@ def threshold_l1(s, l1):
     return jnp.sign(s) * reg
 
 
+def synth_count_channel(hist2: jnp.ndarray, count, sum_h) -> jnp.ndarray:
+    """[2, F, B] (grad, hess) histogram -> [3, F, B] with the count channel
+    synthesized from hessians via the reference's cnt_factor: the reference
+    histogram entry is (grad, hess) only (bin.h:40 kHistEntrySize) and split
+    search derives per-bin counts as RoundInt(hess * num_data / sum_hessian)
+    (FindBestThresholdSequentially, feature_histogram.hpp:529,844). The
+    rounding happens on the cumulative sums inside _numeric_gain_map."""
+    cntf = count / jnp.maximum(sum_h, 1e-12)
+    return jnp.concatenate([hist2, hist2[1:2] * cntf], axis=0)
+
+
 def leaf_output(sum_g, sum_h, hp: SplitHyperParams, num_data, parent_output):
     """reference: CalculateSplittedLeafOutput (feature_histogram.hpp:718)."""
     ret = -threshold_l1(sum_g, hp.lambda_l1) / (sum_h + hp.lambda_l2)
