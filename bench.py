@@ -27,12 +27,20 @@ import numpy as np
 BASELINE_ROW_ITERS_PER_SEC = 10_500_000 * 500 / 130.094
 
 
-def main() -> None:
+def run(metric: str = "binary_train_throughput",
+        default_bin: int = 63) -> None:
     rows = int(os.environ.get("BENCH_ROWS", "4000000"))
     cols = int(os.environ.get("BENCH_COLS", "28"))
     iters = int(os.environ.get("BENCH_ITERS", "32"))
     num_leaves = int(os.environ.get("BENCH_LEAVES", "255"))
-    max_bin = int(os.environ.get("BENCH_BIN", "63"))
+    max_bin = int(os.environ.get("BENCH_BIN", str(default_bin)))
+    # BENCH_PROFILE=1: per-stage device timings ride along in the output
+    # (runtime/profiler.py). NOTE: profiling fences every iteration, so
+    # the throughput number is the per-iteration path, not the batched
+    # scan — don't compare it against unprofiled runs.
+    profile = os.environ.get("BENCH_PROFILE", "") not in ("", "0")
+    # BENCH_AUTOTUNE=1: pick the grower by live probes (runtime/autotune.py)
+    autotune = os.environ.get("BENCH_AUTOTUNE", "") not in ("", "0")
 
     rng = np.random.RandomState(42)
     X = rng.normal(size=(rows, cols)).astype(np.float32)
@@ -43,7 +51,7 @@ def main() -> None:
 
     params = dict(objective="binary", num_leaves=num_leaves, max_bin=max_bin,
                   learning_rate=0.1, min_data_in_leaf=20, verbose=-1,
-                  bagging_freq=0)
+                  bagging_freq=0, device_profile=profile, autotune=autotune)
     ds = lgb.Dataset(X, label=y)
 
     # warmup: one full boosting iteration to trigger jit compilation.
@@ -94,14 +102,25 @@ def main() -> None:
     auc = m.eval(pred, None)[0][1]
 
     row_iters_per_sec = rows * iters / dt
-    print(json.dumps({
-        "metric": "binary_train_throughput",
+    out = {
+        "metric": metric,
         "value": round(row_iters_per_sec, 1),
         "unit": "row_iters_per_sec",
         "vs_baseline": round(row_iters_per_sec / BASELINE_ROW_ITERS_PER_SEC,
                              4),
         "train_auc": round(float(auc), 5),
-    }))
+    }
+    if profile:
+        p = booster.get_profile() or {}
+        p.pop("ring", None)          # keep the line one line
+        out["profile"] = p
+    if autotune:
+        out["autotune"] = booster._gbdt.autotune_decision
+    print(json.dumps(out))
+
+
+def main() -> None:
+    run()
 
 
 if __name__ == "__main__":

@@ -15,7 +15,7 @@ Public API mirrors the reference python package:
 
 from .basic import Booster, Dataset, Sequence
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
-                       record_evaluation, reset_parameter)
+                       record_evaluation, record_profile, reset_parameter)
 from .config import Config, resolve_params
 from .engine import CVBooster, cv, train
 from .utils.log import register_logger
@@ -26,7 +26,7 @@ __all__ = [
     "Dataset", "Booster", "Sequence", "train", "cv", "CVBooster",
     "Config", "resolve_params",
     "early_stopping", "log_evaluation", "record_evaluation",
-    "reset_parameter", "EarlyStopException",
+    "record_profile", "reset_parameter", "EarlyStopException",
     "register_logger",
     "LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker",
 ]

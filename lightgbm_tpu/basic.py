@@ -688,6 +688,13 @@ class Booster:
     def num_trees(self) -> int:
         return len(self._gbdt.models)
 
+    def get_profile(self) -> Optional[Dict[str, Any]]:
+        """Device-profile export (runtime/profiler.py to_dict): per-stage
+        seconds, per-iteration ring buffer, row-iters/s, HBM watermark.
+        None unless trained with device_profile=true."""
+        prof = getattr(self._gbdt, "profiler", None)
+        return prof.to_dict() if prof is not None else None
+
     def num_model_per_iteration(self) -> int:
         return self._gbdt.num_tree_per_iteration
 

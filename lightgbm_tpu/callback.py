@@ -68,6 +68,35 @@ def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]) -> Callabl
     return _callback
 
 
+def record_profile(profile_result: Dict[str, Any]) -> Callable:
+    """Collect per-iteration device-profile stage timings into
+    ``profile_result`` (record_evaluation-style; requires training with
+    ``device_profile=true`` so the booster carries a StageProfiler —
+    otherwise the dict stays empty).
+
+    After training, ``profile_result["stages_s"]`` maps stage name ->
+    list of per-iteration seconds and ``profile_result["wall_s"]`` is the
+    per-iteration wall time; ``profile_result["profile"]`` holds the full
+    final export (lightgbm_tpu/runtime/profiler.py to_dict)."""
+    if not isinstance(profile_result, dict):
+        raise TypeError("profile_result should be a dictionary")
+
+    def _callback(env: CallbackEnv) -> None:
+        gbdt = getattr(env.model, "_gbdt", env.model)
+        prof = getattr(gbdt, "profiler", None)
+        if prof is None or not prof.ring:
+            return
+        last = prof.ring[-1]
+        profile_result.setdefault("wall_s", []).append(last["wall_s"])
+        stages = profile_result.setdefault("stages_s", {})
+        for name, v in last["stages_s"].items():
+            stages.setdefault(name, []).append(v)
+        profile_result["profile"] = prof.to_dict()
+
+    _callback.order = 25  # type: ignore
+    return _callback
+
+
 def reset_parameter(**kwargs: Union[list, Callable]) -> Callable:
     """reference: callback.py:254. Values are lists (per-iteration) or
     callables iteration -> value."""

@@ -38,10 +38,17 @@ def parse_args(argv: List[str]) -> Dict[str, str]:
     from .config import canonical_name
     params: Dict[str, str] = {}
     for arg in argv:
+        # GNU-style switches map onto config params: `--profile` ->
+        # device_profile=true (via the alias table), `--key=value` ->
+        # key=value
+        if arg.startswith("--"):
+            arg = arg[2:]
+            if "=" not in arg:
+                arg += "=true"
         if "=" not in arg:
             log_fatal(f"Unknown CLI argument: {arg} (expected key=value)")
         k, v = arg.split("=", 1)
-        params[canonical_name(k.strip())] = v.strip()
+        params[canonical_name(k.strip().replace("-", "_"))] = v.strip()
     if "config" in params:
         file_params = {canonical_name(k): v for k, v in
                        parse_config_file(params.pop("config")).items()}
@@ -89,6 +96,16 @@ def run_train(params: Dict[str, Any], cfg) -> None:
                            init_model=init_model,
                            callbacks=callbacks or None)
     booster.save_model(cfg.output_model)
+    if cfg.device_profile:
+        profile = booster.get_profile()
+        if profile is not None:
+            import json
+            text = json.dumps(profile, indent=2)
+            if cfg.profile_output:
+                with open(cfg.profile_output, "w") as f:
+                    f.write(text + "\n")
+                log_info(f"Device profile saved to {cfg.profile_output}")
+            print(text)
     log_info(f"Finished training; model saved to {cfg.output_model}")
 
 

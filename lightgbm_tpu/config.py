@@ -140,6 +140,10 @@ _alias("gpu_platform_id", "gpu_platform_id")
 _alias("gpu_device_id", "gpu_device_id")
 _alias("gpu_use_dp", "gpu_use_dp")
 _alias("num_gpu", "num_gpus")
+_alias("device_profile", "profile", "device_profiling")
+_alias("profile_output", "profile_out", "profile_file")
+_alias("autotune", "auto_tune", "runtime_autotune")
+_alias("autotune_cache", "auto_tune_cache", "autotune_cache_filename")
 
 
 @dataclass
@@ -323,6 +327,18 @@ class Config:
     # ops/grow.py GrowConfig.wave_gain_slack)
     tpu_wave_gain_slack: float = 0.3
     tpu_num_shards: int = 0            # 0 = use all local devices for data ||
+    # runtime subsystem (lightgbm_tpu/runtime/): per-iteration stage
+    # profiling with device fencing (--profile on the CLI) and init-time
+    # grower/layout autotuning via timed probes (the reference's
+    # TrainingShareStates row-vs-col timing dance, train_share_states.cpp)
+    device_profile: bool = False
+    profile_output: str = ""           # write profile JSON here ("" = stdout
+    #                                    only via CLI/bench consumers)
+    autotune: bool = False             # probe grower strategies at init;
+    #                                    false = hard-coded ladder, bit-for-bit
+    autotune_cache: str = ""           # decision cache path ("" = env
+    #                                    LIGHTGBM_TPU_AUTOTUNE_CACHE or
+    #                                    ~/.cache/lightgbm_tpu/autotune.json)
 
     def __post_init__(self) -> None:
         self._validate()

@@ -16,6 +16,7 @@ caller needs host trees earlier (save/predict/DART/RF paths).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -175,6 +176,9 @@ class GBDT:
         self.feature_names_: List[str] = []
         self.feature_infos_: List[str] = []
         self.label_idx_ = 0
+        # runtime subsystem state (lightgbm_tpu/runtime/)
+        self.profiler = None
+        self.autotune_decision: Optional[Dict[str, Any]] = None
 
         if train_set is not None:
             self._init_train(train_set)
@@ -182,6 +186,9 @@ class GBDT:
     # ------------------------------------------------------------------
     def _init_train(self, ds: BinnedDataset) -> None:
         cfg = self.config
+        if cfg.device_profile:
+            from ..runtime import StageProfiler
+            self.profiler = StageProfiler()
         self.num_data = ds.num_data
         self.max_feature_idx_ = ds.num_total_features - 1
         self.feature_names_ = list(ds.feature_names)
@@ -270,7 +277,8 @@ class GBDT:
         Xt_np = np.ascontiguousarray(X.T)                   # [F(b), N]
         if self._host_pad != N_real:
             Xt_np = np.pad(Xt_np, ((0, 0), (0, self._host_pad - N_real)))
-        self.X_t = self._put_rows(jnp.asarray(Xt_np), row_axis=1)
+        with self._prof_span("bin"):
+            self.X_t = self._put_rows(jnp.asarray(Xt_np), row_axis=1)
         self.meta = build_feature_meta(ds, cfg.monotone_constraints,
                                        cfg.interaction_constraints)
         if cfg.forcedsplits_filename:
@@ -369,6 +377,13 @@ class GBDT:
             self.grower = "compact"
         else:
             self.grower = "masked"
+        ladder_choice = self.grower
+        # memory feasibility per strategy, reused by the autotuner below
+        self._grower_feasible = ["masked"]
+        if cache_bytes <= pool_limit:
+            self._grower_feasible.insert(0, "compact")
+        if wave_bytes <= pool_limit:
+            self._grower_feasible.insert(0, "wave")
         if self._use_bundles and self.grower not in ("wave",
                                                      "wave_exact"):
             # the memory guard picked a serial grower, but X_t/meta/
@@ -524,7 +539,54 @@ class GBDT:
             self.sample_strategy = create_sample_strategy(cfg, N, md)
         self._in_bag_dev = None
 
+        # -- init-time strategy autotuning (runtime/autotune.py): the
+        # reference's TrainingShareStates timing dance generalized — probe
+        # the feasible growers + histogram chunk layouts on a subsample of
+        # the real binned matrix and route dispatch through the winner.
+        # Default off; feature-constrained configurations (anything that
+        # already forced a specific grower above) keep the ladder choice.
+        if cfg.autotune:
+            constrained = (cfg.tpu_grower != "auto"
+                           or self.grower != ladder_choice
+                           or self.use_dist or self._linear)
+            if constrained:
+                log_warning(
+                    "autotune=true ignored: the grower choice is "
+                    "constrained (forced tpu_grower, distributed/linear "
+                    "mode, or a feature only the wave grower implements)")
+            else:
+                from ..runtime.autotune import autotune_decision
+                with self._prof_span("autotune"):
+                    decision = autotune_decision(
+                        self.X_t, self.meta, self.grow_cfg,
+                        self._grower_feasible,
+                        n_rows=self.num_data,
+                        n_features=len(ds.mappers),
+                        max_bin=max_bin,
+                        num_leaves=cfg.num_leaves,
+                        cache_path=cfg.autotune_cache,
+                        seed=int(cfg.seed or 0))
+                self.autotune_decision = decision
+                if decision.get("grower"):
+                    if decision["grower"] != self.grower:
+                        log_info(
+                            "autotune: probes picked grower "
+                            f"'{decision['grower']}' over ladder choice "
+                            f"'{self.grower}'")
+                    self.grower = decision["grower"]
+                rc = int(decision.get("rows_per_chunk", 0) or 0)
+                if rc > 0 and rc != self.grow_cfg.rows_per_chunk:
+                    self.grow_cfg = self.grow_cfg._replace(
+                        rows_per_chunk=rc)
+                if self.profiler is not None:
+                    self.profiler.extras["autotune"] = decision
+
         self._build_jit_fns()
+
+    def _prof_span(self, name: str):
+        """The active profiler's span, or a no-op context."""
+        return (self.profiler.span(name) if self.profiler is not None
+                else contextlib.nullcontext())
 
     def _put_rows(self, arr: jnp.ndarray, row_axis: int = 0) -> jnp.ndarray:
         """Shard `arr` rows over the mesh data axis (no-op when serial).
@@ -771,6 +833,8 @@ class GBDT:
         window."""
         if type(self) is not GBDT:
             return False          # DART/RF override per-iter behavior
+        if self.profiler is not None:
+            return False          # per-iteration spans need the host fence
         if self._linear:
             return False          # per-tree host ridge fits
         if self.objective is None or self.objective.runs_on_host:
@@ -873,23 +937,28 @@ class GBDT:
         """One boosting iteration (GBDT::TrainOneIter, gbdt.cpp:353).
         Returns True if training should stop (no splits possible)."""
         K = self.num_tree_per_iteration
+        prof = self.profiler
+        if prof is not None:
+            prof.iter_start()
         init_scores = np.zeros(K)
-        if grad is None or hess is None:
-            if self.iter == 0:
-                init_scores = self._boost_from_average()
-            g_dev, h_dev = self.boost()
-        else:
-            grad = np.asarray(grad, np.float32).reshape(K, -1)
-            hess = np.asarray(hess, np.float32).reshape(K, -1)
-            if self._host_pad != self.num_data:
-                pad = ((0, 0), (0, self._host_pad - self.num_data))
-                grad = np.pad(grad, pad)
-                hess = np.pad(hess, pad)
-            g_dev = self._put_rows(jnp.asarray(grad), row_axis=1)
-            h_dev = self._put_rows(jnp.asarray(hess), row_axis=1)
+        with self._prof_span("boost"):
+            if grad is None or hess is None:
+                if self.iter == 0:
+                    init_scores = self._boost_from_average()
+                g_dev, h_dev = self.boost()
+            else:
+                grad = np.asarray(grad, np.float32).reshape(K, -1)
+                hess = np.asarray(hess, np.float32).reshape(K, -1)
+                if self._host_pad != self.num_data:
+                    pad = ((0, 0), (0, self._host_pad - self.num_data))
+                    grad = np.pad(grad, pad)
+                    hess = np.pad(hess, pad)
+                g_dev = self._put_rows(jnp.asarray(grad), row_axis=1)
+                h_dev = self._put_rows(jnp.asarray(hess), row_axis=1)
 
         strat = self.sample_strategy
         if self._in_bag_dev is None or strat.resamples_at(self.iter):
+          with self._prof_span("bagging"):
             if strat.needs_grad:
                 g_arg = g_dev[:, :self.num_data]
                 h_arg = h_dev[:, :self.num_data]
@@ -909,11 +978,12 @@ class GBDT:
         base_seed = self.config.seed or 0
         for k in range(K):
           with global_timer.section("GBDT::TrainOneIter/grow"):
-            tree_dev, leaf_of_row, new_scores = self._train_tree(
-                self.X_t, g_dev[k], h_dev[k],
-                in_bag if in_bag.ndim == 1 else in_bag[k],
-                self.scores[k], lr, feat_mask,
-                jnp.int32((base_seed + self.iter) * K + k))
+            with self._prof_span("grow"):
+                tree_dev, leaf_of_row, new_scores = self._train_tree(
+                    self.X_t, g_dev[k], h_dev[k],
+                    in_bag if in_bag.ndim == 1 else in_bag[k],
+                    self.scores[k], lr, feat_mask,
+                    jnp.int32((base_seed + self.iter) * K + k))
             if (self.objective is not None
                     and self.objective.need_renew_tree_output):
                 tree_dev, new_scores = self._renew_tree_output(
@@ -926,27 +996,45 @@ class GBDT:
                     k, tree_dev, leaf_of_row, g_dev[k], h_dev[k],
                     in_bag if in_bag.ndim == 1 else in_bag[k], bias)
                 continue
-            self.scores = self.scores.at[k].set(new_scores)
-            # valid scores update BEFORE the bias fold: scorers received the
-            # init score separately in _boost_from_average (the reference
-            # updates scores before AddBias, gbdt.cpp:424-428). leaf_value
-            # on the DeviceTree is pre-shrinkage, so lr is applied here.
-            for vi in range(len(self.valid_sets)):
-                self._valid_scores[vi] = self._valid_scores[vi].at[k].set(
-                    self._valid_update(
-                        tree_dev.split_feature, tree_dev.threshold_bin,
-                        tree_dev.default_left, tree_dev.left_child,
-                        tree_dev.right_child, tree_dev.num_leaves,
-                        tree_dev.leaf_value,
-                        self._valid_Xt[vi], tuple(self._valid_meta[vi]),
-                        self._valid_scores[vi][k], lr,
-                        tree_dev.split_is_cat, tree_dev.split_cat_bitset))
+            with self._prof_span("score-update"):
+                self.scores = self.scores.at[k].set(new_scores)
+                # valid scores update BEFORE the bias fold: scorers
+                # received the init score separately in _boost_from_average
+                # (the reference updates scores before AddBias,
+                # gbdt.cpp:424-428). leaf_value on the DeviceTree is
+                # pre-shrinkage, so lr is applied here.
+                for vi in range(len(self.valid_sets)):
+                    self._valid_scores[vi] = \
+                        self._valid_scores[vi].at[k].set(
+                            self._valid_update(
+                                tree_dev.split_feature,
+                                tree_dev.threshold_bin,
+                                tree_dev.default_left, tree_dev.left_child,
+                                tree_dev.right_child, tree_dev.num_leaves,
+                                tree_dev.leaf_value,
+                                self._valid_Xt[vi],
+                                tuple(self._valid_meta[vi]),
+                                self._valid_scores[vi][k], lr,
+                                tree_dev.split_is_cat,
+                                tree_dev.split_cat_bitset))
             # boost-from-average bias is folded into the first tree at
             # materialization time (gbdt.cpp:425-427)
             bias = init_scores[k] if self.iter == 0 else 0.0
             self._pending.append((tree_dev, float(bias)))
 
         self.iter += 1
+        if prof is not None:
+            prof.iter_end(n_rows=self.num_data)
+            if "stage_probe" not in prof.extras and not self.use_dist:
+                # one-time micro-probe decomposition of the fused "grow"
+                # span into histogram / split-search / partition kernels
+                from ..runtime.profiler import probe_stage_breakdown
+                try:
+                    prof.extras["stage_probe"] = probe_stage_breakdown(
+                        self.X_t, g_dev[0], h_dev[0], self.meta,
+                        self.grow_cfg)
+                except Exception:
+                    prof.extras["stage_probe"] = {}
         # The stop condition requires a host readback (~100ms on a tunneled
         # chip), so it is only REALLY evaluated at power-of-2 iterations and
         # then every _stop_check_interval; in between, training streams

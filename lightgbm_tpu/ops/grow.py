@@ -406,6 +406,17 @@ def grow_tree(
         leaf_of_row = jnp.where(valid & in_p & ~go_left, new_leaf,
                                 st.leaf_of_row)
 
+        # -- exact child counts at split time (update_cnt=true,
+        #    serial_tree_learner.cpp:796-799): the true partition count
+        #    feeds the tree metadata and the children's parent count below;
+        #    per-bin counts inside the split scan stay cnt_factor-
+        #    synthesized (synth_count_channel), matching the reference.
+        #    t.leaf_count[p] still holds the parent's count here.
+        n_left = psum(jnp.sum(cnt_row * (in_p & go_left).astype(jnp.float32)))
+        bs = bs._replace(
+            left_count=n_left,
+            right_count=t.leaf_count[p].astype(jnp.float32) - n_left)
+
         # -- per-leaf bookkeeping
         depth_child = st.leaf_depth[p] + 1
         leaf_parent_node = st.leaf_parent_node.at[p].set(

@@ -31,10 +31,12 @@ split mathematics, identical best-first (leaf-wise) order — into batched
 Order semantics by mode:
   * wave_exact=True: same priority-queue order as the serial growers
     (serial_tree_learner.cpp:222; argmax ties by index); only the schedule
-    of device work differs. Histograms carry an exact count channel
-    (the 0/1 in-bag indicator, exact in the bf16 contraction), so
-    min_data_in_leaf decisions and count metadata match the serial
-    growers exactly. Cost: ~O(priority-chain) waves.
+    of device work differs. Histogram entries are (grad, hess) pairs and
+    per-bin counts are cnt_factor-synthesized at search time
+    (synth_count_channel, matching the reference's
+    feature_histogram.hpp:529,844) — the same count semantics as every
+    other grower mode; see docs/PARITY.md for the known rounding
+    deviations. Cost: ~O(priority-chain) waves.
   * wave_exact=False (default): each wave applies EVERY ready leaf whose
     gain >= wave_gain_slack * (best frontier gain), in gain order — a
     gain-prioritized batched frontier that approaches strict leaf-wise as

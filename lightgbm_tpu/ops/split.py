@@ -14,10 +14,15 @@ the 128-wide lane dimension — cumsums and compares vectorize perfectly. The
 previous [F, B, 3] layout put 3 on the minor axis, which the TPU pads to a
 full lane tile (42x wasted VPU work).
 
-Gain math is the exact reference formula set (ThresholdL1 /
+Gain math follows the reference formula set (ThresholdL1 /
 CalculateSplittedLeafOutput / GetLeafGainGivenOutput,
 feature_histogram.hpp:712-829) including lambda_l1/l2, max_delta_step and
-path_smooth; data/hessian constraints follow :877-893.
+path_smooth; data/hessian constraints follow :877-893. It is NOT bit-exact:
+per-bin counts are synthesized from hessians (`synth_count_channel` below)
+and rounded on CUMULATIVE sums rather than per bin, and the bf16 Pallas
+histogram path adds ~2^-9 relative hessian noise — both can flip
+min_data_in_leaf decisions on bins within a row or two of the threshold.
+See docs/PARITY.md for the catalogued deviations and their bounds.
 
 Direction semantics (feature_histogram.hpp:855-1030):
  - forward scan: missing-valued rows fall RIGHT (default_left=False)

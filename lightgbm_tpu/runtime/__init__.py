@@ -1,0 +1,30 @@
+"""Runtime subsystem: device profiling and kernel/strategy autotuning.
+
+The reference locks in a histogram layout by *measuring* it: at InitTrain,
+TrainingShareStates times row-wise vs col-wise histogram construction on
+the real data and keeps the faster one (src/io/train_share_states.cpp).
+This package is that idea generalized for the TPU build:
+
+ * `profiler`  — per-iteration stage spans with proper device fencing
+   (block_until_ready around jitted segments), throughput counters,
+   an HBM watermark, a ring buffer, and JSON export consumed by
+   bench.py / BENCH_*.json. Absorbs the old `utils/timer.py`
+   global-timer machinery (which now re-exports from here).
+ * `autotune`  — at train init, short timed probes of the candidate
+   grower strategies (ops/grow.py / grow_fast.py / grow_wave.py) and
+   histogram chunk layouts on a subsample of the real binned matrix;
+   the winner is cached in-process and on disk keyed by
+   (n_rows, n_features, max_bin, num_leaves, device kind).
+
+Enabled through config: `device_profile=true` (alias `profile`, CLI
+`--profile`) and `autotune=true`. Both default off; `autotune=false`
+reproduces the hard-coded strategy ladder bit-for-bit.
+
+Imports stay lazy/light here: this module must be importable before any
+XLA backend is initialized (multi-host bring-up orders
+jax.distributed.initialize before the first backend touch).
+"""
+
+from .profiler import StageProfiler, Timer, global_timer, trace  # noqa: F401
+from .autotune import (AUTOTUNE_PREFERENCE, autotune_decision,  # noqa: F401
+                       load_disk_cache, make_key, save_disk_cache)

@@ -1,0 +1,278 @@
+"""Init-time strategy autotuning via short timed probes.
+
+The reference picks its histogram layout by measurement, not heuristics:
+``TrainingShareStates::CalcBinOffsets``/``InitTrain`` times row-wise vs
+col-wise histogram construction on the real data and locks in the faster
+one (src/io/train_share_states.cpp). This module is the same timing
+dance for the TPU build's real degrees of freedom:
+
+ * which grower strategy — ``wave`` (ops/grow_wave.py), ``compact``
+   (ops/grow_fast.py), ``masked`` (ops/grow.py) — by growing one probe
+   tree per candidate on a row subsample of the REAL binned matrix with
+   synthetic gradients from a fixed seed;
+ * the histogram chunk layout (``rows_per_chunk``) by timing
+   ``build_histogram`` at candidate chunk sizes.
+
+Decisions are cached in-process and on disk, keyed by
+(n_rows, n_features, max_bin, num_leaves, device kind) — the shape
+signature that determines kernel behavior, so a rerun of the same
+workload skips the probes entirely.
+
+Determinism: probe gradients come from a fixed ``seed`` and the timing
+clock is injectable (``timer``), so tests can force exact tie-breaks.
+Ties within ``TIE_TOL`` resolve by ``AUTOTUNE_PREFERENCE`` order, which
+matches the hard-coded ladder's ordering — a tie reproduces the ladder.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+# ladder order (models/gbdt.py grower selection): on a timing tie the
+# autotuner must agree with the memory ladder's preference
+AUTOTUNE_PREFERENCE = ("wave", "wave_exact", "compact", "masked")
+
+# two timings within 2% are a tie (probe noise floor)
+TIE_TOL = 0.02
+
+DEFAULT_PROBE_ROWS = 65536
+CHUNK_CANDIDATES = (4096, 8192, 32768)
+
+# in-process decision cache: key -> decision dict
+_MEM_CACHE: Dict[str, Dict[str, Any]] = {}
+
+
+def make_key(n_rows: int, n_features: int, max_bin: int, num_leaves: int,
+             device_kind: str = "") -> str:
+    """Cache key over the shape signature that determines kernel choice."""
+    if not device_kind:
+        try:
+            import jax
+            device_kind = jax.local_devices()[0].device_kind
+        except Exception:
+            device_kind = "unknown"
+    dk = str(device_kind).replace(" ", "_")
+    return f"r{int(n_rows)}_f{int(n_features)}_b{int(max_bin)}" \
+           f"_l{int(num_leaves)}_{dk}"
+
+
+def default_cache_path() -> str:
+    env = os.environ.get("LIGHTGBM_TPU_AUTOTUNE_CACHE", "")
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "lightgbm_tpu", "autotune.json")
+
+
+def load_disk_cache(path: str) -> Dict[str, Dict[str, Any]]:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        return data if isinstance(data, dict) else {}
+    except Exception:
+        return {}
+
+
+def save_disk_cache(path: str, cache: Dict[str, Dict[str, Any]]) -> None:
+    try:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(cache, f, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+    except Exception:
+        pass   # a cold cache next run, never a training failure
+
+
+def _grower_fn(name: str):
+    if name in ("wave", "wave_exact"):
+        from ..ops.grow_wave import grow_tree_wave
+        return grow_tree_wave, True
+    if name == "compact":
+        from ..ops.grow_fast import grow_tree_fast
+        return grow_tree_fast, False
+    from ..ops.grow import grow_tree
+    return grow_tree, False
+
+
+def _block(out) -> None:
+    import jax
+    jax.tree_util.tree_map(
+        lambda x: x.block_until_ready()
+        if hasattr(x, "block_until_ready") else x, out)
+
+
+def probe_strategies(X_t, meta, cfg, candidates: Sequence[str],
+                     probe_rows: int = DEFAULT_PROBE_ROWS, seed: int = 0,
+                     timer: Callable[[], float] = time.perf_counter,
+                     ) -> Dict[str, float]:
+    """Grow one probe tree per candidate grower on a row subsample of the
+    real binned matrix; return {candidate: best_of_2_seconds}.
+
+    Gradients are synthetic (fixed ``seed``, binary-like: uniform grad in
+    [-0.5, 0.5), constant hessian 0.25) so the probe exercises the real
+    split math without touching training state. A candidate that fails to
+    compile/run simply drops out of the timing table.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .profiler import device_barrier
+
+    n = int(X_t.shape[1])
+    m = max(min(int(probe_rows), n), 1)
+    Xs = jnp.asarray(jax.device_get(X_t[:, :m]))
+    rng = np.random.RandomState(seed)
+    g = jnp.asarray(rng.uniform(-0.5, 0.5, size=m).astype(np.float32))
+    h = jnp.full((m,), 0.25, jnp.float32)
+    bag = jnp.ones((m,), jnp.float32)
+
+    timings: Dict[str, float] = {}
+    for name in candidates:
+        grow_fn, takes_seed = _grower_fn(name)
+        cfg_c = cfg._replace(wave_exact=(name == "wave_exact"))
+
+        def run(X, gg, hh, bb, _fn=grow_fn, _cfg=cfg_c, _seed=takes_seed):
+            kw = {"rng_seed": jnp.int32(seed)} if _seed else {}
+            return _fn(X, gg, hh, bb, meta, _cfg, **kw)
+
+        try:
+            jitted = jax.jit(run)
+            _block(jitted(Xs, g, h, bag))         # compile + warm
+            best = float("inf")
+            for _ in range(2):
+                device_barrier()
+                t0 = timer()
+                _block(jitted(Xs, g, h, bag))
+                best = min(best, timer() - t0)
+            timings[name] = best
+        except Exception as e:                    # noqa: BLE001
+            from ..utils.log import log_warning
+            log_warning(f"autotune: probe for grower '{name}' failed "
+                        f"({type(e).__name__}); dropping candidate")
+    return timings
+
+
+def probe_rows_per_chunk(X_t, cfg, chunk_candidates: Sequence[int]
+                         = CHUNK_CANDIDATES,
+                         probe_rows: int = DEFAULT_PROBE_ROWS,
+                         seed: int = 0,
+                         timer: Callable[[], float] = time.perf_counter,
+                         ) -> Dict[int, float]:
+    """Time ``build_histogram`` at candidate chunk sizes on the real
+    binned subsample (the direct analog of the reference's row-wise vs
+    col-wise layout timing)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops.histogram import build_histogram
+    from .profiler import device_barrier
+
+    n = int(X_t.shape[1])
+    m = max(min(int(probe_rows), n), 1)
+    Xs = jnp.asarray(jax.device_get(X_t[:, :m]))
+    rng = np.random.RandomState(seed)
+    vals = jnp.asarray(                                     # [2, N]
+        rng.uniform(-0.5, 0.5, size=(2, m)).astype(np.float32))
+    B = int(cfg.num_bins_padded)
+
+    timings: Dict[int, float] = {}
+    for rc in chunk_candidates:
+        rc = int(rc)
+
+        def run(X, v, _rc=rc):
+            return build_histogram(X, v, B, rows_per_chunk=_rc)
+
+        try:
+            jitted = jax.jit(run)
+            _block(jitted(Xs, vals))
+            best = float("inf")
+            for _ in range(2):
+                device_barrier()
+                t0 = timer()
+                _block(jitted(Xs, vals))
+                best = min(best, timer() - t0)
+            timings[rc] = best
+        except Exception:
+            pass
+    return timings
+
+
+def _pick_winner(timings: Dict[str, float],
+                 preference: Sequence[str]) -> Optional[str]:
+    """Fastest candidate; ties within TIE_TOL resolve by preference
+    order (then by insertion order for unlisted names)."""
+    if not timings:
+        return None
+    t_best = min(timings.values())
+    tied = [k for k, v in timings.items() if v <= t_best * (1.0 + TIE_TOL)]
+
+    def rank(name: str) -> int:
+        try:
+            return preference.index(name)
+        except ValueError:
+            return len(preference) + list(timings).index(name)
+
+    return min(tied, key=rank)
+
+
+def autotune_decision(X_t, meta, cfg, candidates: Sequence[str], *,
+                      n_rows: int, n_features: int, max_bin: int,
+                      num_leaves: int, cache_path: str = "",
+                      probe_rows: int = DEFAULT_PROBE_ROWS, seed: int = 0,
+                      timer: Callable[[], float] = time.perf_counter,
+                      tune_chunks: bool = True) -> Dict[str, Any]:
+    """Full decision: cached if seen, otherwise probe and cache.
+
+    Returns ``{"grower", "rows_per_chunk", "timings", "chunk_timings",
+    "key", "probe_rows", "cached"}``. ``grower`` is None when every
+    probe failed (caller keeps its ladder choice).
+    """
+    key = make_key(n_rows, n_features, max_bin, num_leaves)
+    if key in _MEM_CACHE:
+        return dict(_MEM_CACHE[key], cached="memory")
+    path = cache_path or default_cache_path()
+    disk = load_disk_cache(path)
+    hit = disk.get(key)
+    if isinstance(hit, dict) and hit.get("grower") in (None, *candidates):
+        _MEM_CACHE[key] = hit
+        return dict(hit, cached="disk")
+
+    timings = probe_strategies(X_t, meta, cfg, candidates,
+                               probe_rows=probe_rows, seed=seed, timer=timer)
+    winner = _pick_winner(timings, AUTOTUNE_PREFERENCE)
+
+    chunk_timings: Dict[int, float] = {}
+    rows_per_chunk = int(cfg.rows_per_chunk)
+    if tune_chunks:
+        cands = sorted({*CHUNK_CANDIDATES, rows_per_chunk})
+        chunk_timings = probe_rows_per_chunk(
+            X_t, cfg, cands, probe_rows=probe_rows, seed=seed, timer=timer)
+        if chunk_timings:
+            # prefer the configured chunk size on a tie (stable jit keys)
+            pref = [str(rows_per_chunk)] + [str(c) for c in cands]
+            best = _pick_winner(
+                {str(k): v for k, v in chunk_timings.items()}, pref)
+            if best is not None:
+                rows_per_chunk = int(best)
+
+    decision: Dict[str, Any] = {
+        "grower": winner,
+        "rows_per_chunk": rows_per_chunk,
+        "timings": {k: round(v, 6) for k, v in timings.items()},
+        "chunk_timings": {str(k): round(v, 6)
+                          for k, v in chunk_timings.items()},
+        "key": key,
+        "probe_rows": min(int(probe_rows), int(X_t.shape[1])),
+    }
+    _MEM_CACHE[key] = decision
+    disk[key] = decision
+    save_disk_cache(path, disk)
+    return dict(decision, cached=False)

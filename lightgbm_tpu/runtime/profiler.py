@@ -1,0 +1,323 @@
+"""Device-fenced stage profiling (absorbs the old ``utils/timer.py``).
+
+Two layers live here:
+
+ * ``Timer`` — the process-global named-phase accumulator, the analog of
+   the reference's ``Common::Timer global_timer`` with RAII
+   ``FunctionTimer`` sections (utils/common.h:980,1044; printed at exit
+   when built with USE_TIMETAG). Unchanged API; ``utils/timer.py`` now
+   re-exports it for back-compat.
+ * ``StageProfiler`` — per-iteration stage spans with proper device
+   synchronization. JAX dispatches asynchronously, so every span is
+   fenced with a device barrier (``jax.effects_barrier`` + blocking the
+   live arrays) before and after; the host clock then brackets real
+   device wall time. Each iteration records named spans plus an
+   ``other`` catch-all (iteration wall minus the sum of explicit spans)
+   so the per-stage breakdown always sums to the measured wall time.
+   A bounded ring buffer keeps the most recent iterations; totals,
+   throughput counters (row-iters/s) and an HBM watermark
+   (``jax.local_devices()[0].memory_stats()``) accumulate for the whole
+   run. ``to_dict``/``export_json`` emit the JSON shape consumed by
+   bench.py / BENCH_*.json and by the ``--profile`` CLI flag.
+
+The growers are single fused jits, so the host cannot fence *inside*
+them; ``probe_stage_breakdown`` fills that gap by timing jitted
+micro-probes of the constituent kernels (histogram build, split search,
+partition) once, giving a representative per-stage decomposition of the
+opaque ``grow`` span.
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import contextlib
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+
+def device_barrier() -> None:
+    """Wait for all dispatched device work (best effort; never raises).
+
+    ``effects_barrier`` flushes ordered effects, then blocking every live
+    array flushes the async dispatch queue — together a full fence on
+    every backend we run on (CPU/TPU, single- or multi-device)."""
+    try:
+        import jax
+        (jax.effects_barrier if hasattr(jax, "effects_barrier")
+         else lambda: None)()
+        for d in jax.live_arrays():
+            d.block_until_ready()
+    except Exception:
+        pass
+
+
+class Timer:
+    """reference: Common::Timer (utils/common.h:980)."""
+
+    def __init__(self) -> None:
+        self.acc: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+        self._printed = False
+
+    @contextlib.contextmanager
+    def section(self, name: str, block: bool = False):
+        """Time a named section (FunctionTimer, common.h:1044). With
+        block=True, waits for all dispatched device work first and after
+        (so the section reflects device wall time)."""
+        if block:
+            self._barrier()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if block:
+                self._barrier()
+            dt = time.perf_counter() - t0
+            self.acc[name] = self.acc.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    _barrier = staticmethod(device_barrier)
+
+    def summary(self) -> str:
+        lines = ["[LightGBM-TPU] [Info] Time summary:"]
+        for name in sorted(self.acc, key=lambda n: -self.acc[n]):
+            lines.append(f"  {name}: {self.acc[name]:.3f}s "
+                         f"({self.counts[name]} calls)")
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        self.acc.clear()
+        self.counts.clear()
+
+    def print_summary(self) -> None:
+        from ..utils.log import log_info
+        for line in self.summary().split("\n"):
+            log_info(line)
+
+
+global_timer = Timer()
+
+if os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0", "false"):
+    atexit.register(lambda: global_timer.acc
+                    and global_timer.print_summary())
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture an XLA device profile for the enclosed region (the TPU
+    analog of the reference's USE_TIMETAG device phases; view with
+    tensorboard or xprof)."""
+    import jax
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def _hbm_peak_bytes() -> Optional[int]:
+    """Current peak device memory, or None where the backend has no
+    allocator stats (CPU, some TPU runtimes)."""
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats()
+        if not stats:
+            return None
+        return int(stats.get("peak_bytes_in_use",
+                             stats.get("bytes_in_use", 0))) or None
+    except Exception:
+        return None
+
+
+class StageProfiler:
+    """Per-iteration stage spans, device-fenced, with a ring buffer.
+
+    Usage from the training loop::
+
+        prof.iter_start()
+        with prof.span("boost"): ...
+        with prof.span("grow"): ...
+        prof.iter_end(n_rows=...)
+
+    Spans outside an iteration (e.g. the one-time "bin" upload at init)
+    accumulate into totals only. ``clock`` is injectable for tests.
+    """
+
+    RING_SIZE = 512
+
+    def __init__(self, ring_size: int = RING_SIZE,
+                 clock: Callable[[], float] = time.perf_counter,
+                 barrier: Callable[[], None] = device_barrier) -> None:
+        self._clock = clock
+        self._barrier = barrier
+        self.ring: collections.deque = collections.deque(maxlen=ring_size)
+        self.totals: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+        self.counters: Dict[str, float] = {}
+        self.extras: Dict[str, Any] = {}
+        self.n_iters = 0
+        self.total_wall = 0.0
+        self.total_rows = 0
+        self.hbm_peak_bytes: Optional[int] = None
+        self._iter_t0: Optional[float] = None
+        self._iter_spans: Optional[Dict[str, float]] = None
+
+    # -- span recording ---------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        """Fence the device, time the block, fence again. Inside an
+        iteration the span lands in that iteration's record; outside it
+        accumulates into totals only (init-scope work such as "bin")."""
+        self._barrier()
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            self._barrier()
+            dt = self._clock() - t0
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+            if self._iter_spans is not None:
+                self._iter_spans[name] = self._iter_spans.get(name, 0.0) + dt
+
+    def iter_start(self) -> None:
+        self._barrier()
+        self._iter_spans = {}
+        self._iter_t0 = self._clock()
+
+    def iter_end(self, n_rows: int = 0) -> None:
+        if self._iter_t0 is None:
+            return
+        self._barrier()
+        wall = self._clock() - self._iter_t0
+        spans = self._iter_spans or {}
+        # catch-all: host-side work between spans, so the stage breakdown
+        # always sums to the iteration wall time
+        other = wall - sum(spans.values())
+        if other > 0.0:
+            spans["other"] = other
+            self.totals["other"] = self.totals.get("other", 0.0) + other
+        self.ring.append({"iter": self.n_iters, "wall_s": wall,
+                          "stages_s": spans})
+        self.n_iters += 1
+        self.total_wall += wall
+        self.total_rows += int(n_rows)
+        self._iter_t0 = None
+        self._iter_spans = None
+        peak = _hbm_peak_bytes()
+        if peak is not None:
+            self.hbm_peak_bytes = max(self.hbm_peak_bytes or 0, peak)
+
+    def add_counter(self, name: str, value: float) -> None:
+        self.counters[name] = self.counters.get(name, 0.0) + value
+
+    # -- export -----------------------------------------------------------
+
+    def row_iters_per_sec(self) -> Optional[float]:
+        if self.total_wall <= 0.0 or self.total_rows <= 0:
+            return None
+        return self.total_rows / self.total_wall
+
+    def to_dict(self) -> Dict[str, Any]:
+        stages = {n: round(v, 6) for n, v in
+                  sorted(self.totals.items(), key=lambda kv: -kv[1])}
+        out: Dict[str, Any] = {
+            "n_iters": self.n_iters,
+            "total_wall_s": round(self.total_wall, 6),
+            "stages_s": stages,
+            "stage_counts": dict(self.counts),
+            "ring": list(self.ring),
+        }
+        rps = self.row_iters_per_sec()
+        if rps is not None:
+            out["row_iters_per_sec"] = round(rps, 1)
+        if self.counters:
+            out["counters"] = {n: round(v, 6)
+                               for n, v in self.counters.items()}
+        if self.hbm_peak_bytes is not None:
+            out["hbm_peak_bytes"] = self.hbm_peak_bytes
+        if self.extras:
+            out.update(self.extras)
+        return out
+
+    def export_json(self, path: str = "") -> str:
+        """Serialize; when ``path`` is set also write the file."""
+        text = json.dumps(self.to_dict(), indent=2, sort_keys=False)
+        if path:
+            with open(path, "w") as f:
+                f.write(text + "\n")
+        return text
+
+
+def probe_stage_breakdown(X_t, grad, hess, meta, cfg,
+                          n_probe_rows: int = 16384) -> Dict[str, float]:
+    """One-time decomposition of the fused grow step into its constituent
+    kernels (histogram build, split search, partition), each timed as a
+    separate jit with device fencing.
+
+    The per-iteration ``grow`` span is opaque (one fused jit); this gives
+    the stage-level attribution the reference gets from USE_TIMETAG
+    phases. Returned seconds are representative single-shot costs at the
+    probe size, not exact shares of the fused kernel.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import histogram as H
+    from ..ops import split as S
+
+    n = int(X_t.shape[1])
+    m = min(int(n_probe_rows), n)
+    Xs = jnp.asarray(jax.device_get(X_t[:, :m]))
+    g = jnp.asarray(jax.device_get(grad[:m]), jnp.float32)
+    h = jnp.asarray(jax.device_get(hess[:m]), jnp.float32)
+    B = int(cfg.num_bins_padded)
+
+    def timed(fn, *args) -> float:
+        jitted = jax.jit(fn)
+
+        def run():
+            out = jitted(*args)
+            jax.tree_util.tree_map(
+                lambda x: x.block_until_ready() if hasattr(
+                    x, "block_until_ready") else x, out)
+            return out
+
+        run()                       # compile + warm
+        device_barrier()
+        t0 = time.perf_counter()
+        run()
+        return time.perf_counter() - t0
+
+    out: Dict[str, float] = {"probe_rows": m}
+
+    vals = jnp.stack([g, h])                                # [2, N]
+    out["histogram_s"] = round(
+        timed(lambda X, v: H.build_histogram(X, v, B), Xs, vals), 6)
+
+    # split search on the probe histogram; skipped when the histogram
+    # feature axis doesn't match meta (EFB bundles re-slice it at search
+    # time inside the grower, which the micro-probe doesn't replicate)
+    if not getattr(cfg, "bundled", False):
+        try:
+            hist2 = jax.jit(
+                lambda X, v: H.build_histogram(X, v, B))(Xs, vals)
+            gsum, hsum = jnp.sum(g), jnp.sum(h)
+            cnt = jnp.float32(m)
+            hp = cfg.hp
+
+            def split_probe(hh, gs, hs, c):
+                h3 = S.synth_count_channel(hh, c, hs)
+                return S.find_best_split(h3, gs, hs, c, jnp.float32(0.0),
+                                         meta, hp)
+
+            out["split_search_s"] = round(
+                timed(split_probe, hist2, gsum, hsum, cnt), 6)
+        except Exception:
+            pass
+
+    thr = jnp.int32(B // 2)
+    out["partition_s"] = round(
+        timed(lambda X, t: (X[0] <= t).astype(jnp.int32), Xs, thr), 6)
+    return out

@@ -1,91 +1,6 @@
-"""Named-phase timers + profiler hooks.
+"""Back-compat shim: the timer machinery moved to ``runtime/profiler.py``
+(which also hosts the per-iteration StageProfiler). Import from
+``lightgbm_tpu.runtime`` in new code."""
 
-Analog of the reference's `Common::Timer global_timer` with RAII
-`FunctionTimer` sections (utils/common.h:980,1044; printed at exit when
-built with USE_TIMETAG, CMakeLists.txt:11). Here: a process-global timer
-with context-manager sections, summary printing at exit when
-LIGHTGBM_TPU_TIMETAG=1 (the env-var analog of the build flag), and a
-`jax.profiler` trace hook for device-level profiles.
-
-Caveat: device work dispatches asynchronously, so host sections measure
-dispatch+Python time unless `block` forces a device barrier. Use
-`trace()` (XLA profiler) for true device timelines.
-"""
-
-from __future__ import annotations
-
-import atexit
-import contextlib
-import os
-import time
-from typing import Dict
-
-
-class Timer:
-    """reference: Common::Timer (utils/common.h:980)."""
-
-    def __init__(self) -> None:
-        self.acc: Dict[str, float] = {}
-        self.counts: Dict[str, int] = {}
-        self._printed = False
-
-    @contextlib.contextmanager
-    def section(self, name: str, block: bool = False):
-        """Time a named section (FunctionTimer, common.h:1044). With
-        block=True, waits for all dispatched device work first and after
-        (so the section reflects device wall time)."""
-        if block:
-            self._barrier()
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            if block:
-                self._barrier()
-            dt = time.perf_counter() - t0
-            self.acc[name] = self.acc.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
-
-    @staticmethod
-    def _barrier() -> None:
-        try:
-            import jax
-            (jax.effects_barrier if hasattr(jax, "effects_barrier")
-             else lambda: None)()
-            for d in jax.live_arrays():
-                d.block_until_ready()
-        except Exception:
-            pass
-
-    def summary(self) -> str:
-        lines = ["[LightGBM-TPU] [Info] Time summary:"]
-        for name in sorted(self.acc, key=lambda n: -self.acc[n]):
-            lines.append(f"  {name}: {self.acc[name]:.3f}s "
-                         f"({self.counts[name]} calls)")
-        return "\n".join(lines)
-
-    def reset(self) -> None:
-        self.acc.clear()
-        self.counts.clear()
-
-    def print_summary(self) -> None:
-        from .log import log_info
-        for line in self.summary().split("\n"):
-            log_info(line)
-
-
-global_timer = Timer()
-
-if os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0", "false"):
-    atexit.register(lambda: global_timer.acc
-                    and global_timer.print_summary())
-
-
-@contextlib.contextmanager
-def trace(log_dir: str):
-    """Capture an XLA device profile for the enclosed region (the TPU
-    analog of the reference's USE_TIMETAG device phases; view with
-    tensorboard or xprof)."""
-    import jax
-    with jax.profiler.trace(log_dir):
-        yield
+from ..runtime.profiler import (Timer, device_barrier,  # noqa: F401
+                                global_timer, trace)

@@ -1,0 +1,28 @@
+"""255-bin training throughput benchmark.
+
+Re-measures the 255-bin/uint16 histogram path (last recorded at 0.19x in
+an early BENCH_EXTRAS.json, before the two-value (grad, hess) histogram
+entries landed) with exactly bench.py's methodology and JSON shape —
+only the metric name and the default bin width differ, so downstream
+BENCH_*.json consumers can diff the two lines directly.
+
+Same env knobs as bench.py: BENCH_ROWS / BENCH_COLS / BENCH_ITERS /
+BENCH_LEAVES / BENCH_BIN (default 255 here) / BENCH_PROFILE /
+BENCH_AUTOTUNE.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import bench
+
+
+def main() -> None:
+    bench.run(metric="binary_train_throughput_255bin", default_bin=255)
+
+
+if __name__ == "__main__":
+    main()
