@@ -120,6 +120,15 @@ def run(metric: str = "binary_train_throughput",
 
 
 def main() -> None:
+    # BENCH_SERVING=1: run the serving bench instead (naive per-call
+    # predict vs micro-batched serving; scripts/bench_serving.py)
+    if os.environ.get("BENCH_SERVING", "") not in ("", "0"):
+        import runpy
+        runpy.run_path(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "scripts", "bench_serving.py"),
+            run_name="__main__")
+        return
     run()
 
 

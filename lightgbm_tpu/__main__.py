@@ -1,0 +1,9 @@
+"""`python -m lightgbm_tpu key=value ...` == the reference's lightgbm
+binary (src/main.cpp); see cli.py."""
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

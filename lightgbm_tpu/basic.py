@@ -776,6 +776,14 @@ class Booster:
                                   start_iteration=start_iteration,
                                   num_iteration=ni, **es_kwargs)
 
+    def serve(self, **kwargs) -> "Any":
+        """Production inference session over this model: pinned packed
+        trees, per-bucket compiled predictor cache, optional multi-device
+        sharding (serving/session.py, docs/SERVING.md). Host-engine
+        outputs are bit-identical to :meth:`predict`."""
+        from .serving import ServingSession
+        return ServingSession.from_booster(self, **kwargs)
+
     # ------------------------------------------------------------------
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0,

@@ -2,7 +2,9 @@
 
 Mirrors the reference CLI (src/main.cpp + src/application/application.cpp):
 `lightgbm_tpu config=train.conf [key=value ...]` with
-task = train | predict | refit | save_binary | convert_model.
+task = train | predict | refit | save_binary | convert_model | serve
+(serve is new here: the lightgbm_tpu/serving/ engine behind a CSV/stdin
+loop or a minimal HTTP front-end, docs/SERVING.md).
 Config files are `key = value` lines with `#` comments
 (reference: Application::LoadParameters, application.cpp:54).
 """
@@ -87,8 +89,10 @@ def run_train(params: Dict[str, Any], cfg) -> None:
         def _snapshot(env):
             it = env.iteration + 1
             if it % cfg.snapshot_freq == 0:
+                # .txt suffix so the serving registry's snapshot watcher
+                # (task=serve serve_watch=...) can hot-swap these in
                 env.model.save_model(
-                    f"{cfg.output_model}.snapshot_iter_{it}")
+                    f"{cfg.output_model}.snapshot_iter_{it}.txt")
         callbacks.append(_snapshot)
     booster = engine_train(params, train_set,
                            num_boost_round=cfg.num_iterations,
@@ -155,6 +159,149 @@ def run_refit(params: Dict[str, Any], cfg) -> None:
     log_info(f"Finished refit; model saved to {cfg.output_model}")
 
 
+def _parse_rows(text: str) -> np.ndarray:
+    """Request body -> [n, F] f64: JSON (list-of-rows or {"rows": ...})
+    or delimited lines (tab / comma / space)."""
+    text = text.strip()
+    if text.startswith("{") or text.startswith("["):
+        import json
+        obj = json.loads(text)
+        if isinstance(obj, dict):
+            obj = obj.get("rows", obj.get("data"))
+        rows = np.asarray(obj, np.float64)
+    else:
+        rows = np.asarray(
+            [[float(t) if t.lower() not in ("", "na", "nan") else np.nan
+              for t in line.replace(",", "\t").split()]
+             for line in text.replace("\t", " ").splitlines() if line.strip()],
+            np.float64)
+    return rows.reshape(1, -1) if rows.ndim == 1 else rows
+
+
+def build_http_server(cfg, registry, batcher, metrics):
+    """Minimal threaded HTTP front-end (POST /predict, GET /metrics,
+    GET /health). Factory so tests can bind port 0 and read back
+    `server.server_address`; `serve_forever` is the caller's call."""
+    import http.server
+    import json
+
+    from .serving import QueueFullError, RequestTimeout
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *args):   # keep serving stdout quiet
+            pass
+
+        def _send(self, code: int, obj) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/metrics":
+                self._send(200, metrics.to_dict())
+            elif self.path == "/health":
+                self._send(200, {"status": "ok",
+                                 "models": registry.names()})
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/predict":
+                return self._send(404, {"error": f"no route {self.path}"})
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                rows = _parse_rows(self.rfile.read(n).decode())
+                pred = np.asarray(batcher.predict(rows))
+                self._send(200, {"predictions": pred.tolist()})
+            except QueueFullError as e:
+                self._send(503, {"error": str(e)})
+            except RequestTimeout as e:
+                self._send(504, {"error": str(e)})
+            except Exception as e:
+                self._send(400, {"error": str(e)})
+
+    return http.server.ThreadingHTTPServer(
+        (cfg.serve_host, cfg.serve_port), Handler)
+
+
+def run_serve(params: Dict[str, Any], cfg) -> None:
+    """task=serve: score via the serving engine (registry + batcher).
+    serve_port > 0 -> HTTP; data=<file> -> batch-score the file (output
+    bit-identical to task=predict on the host engine); else stdin lines."""
+    if not cfg.input_model:
+        log_fatal("task=serve requires input_model")
+    from .serving import MicroBatcher, ModelRegistry, ServingMetrics
+    metrics = ServingMetrics(max_batch=cfg.serve_max_batch)
+    registry = ModelRegistry(
+        metrics=metrics, engine=cfg.serve_engine,
+        max_batch=cfg.serve_max_batch, min_bucket=cfg.serve_min_bucket,
+        num_shards=cfg.serve_num_shards, warmup=cfg.serve_warmup,
+        start_iteration=cfg.start_iteration_predict,
+        num_iteration=cfg.num_iteration_predict)
+    registry.register("default", cfg.input_model)
+    if cfg.serve_watch:
+        registry.watch_snapshots("default", cfg.serve_watch,
+                                 poll_s=cfg.serve_watch_poll_s,
+                                 start=cfg.serve_port > 0)
+    batcher = MicroBatcher(
+        lambda X: registry.predict(X, raw_score=cfg.predict_raw_score),
+        max_batch=cfg.serve_max_batch, max_wait_ms=cfg.serve_batch_wait_ms,
+        queue_depth=cfg.serve_queue_depth,
+        timeout_ms=cfg.serve_request_timeout_ms, metrics=metrics)
+    batcher.start()
+    try:
+        if cfg.serve_port > 0:
+            server = build_http_server(cfg, registry, batcher, metrics)
+            log_info(f"serving on http://{server.server_address[0]}:"
+                     f"{server.server_address[1]} (POST /predict, "
+                     f"GET /metrics, GET /health)")
+            try:
+                server.serve_forever()
+            except KeyboardInterrupt:
+                pass
+            finally:
+                server.server_close()
+        elif cfg.data:
+            X, _, _, _, _ = load_text_file(
+                cfg.data, has_header=cfg.header,
+                label_column=cfg.label_column,
+                weight_column=cfg.weight_column,
+                group_column=cfg.group_column,
+                ignore_column=cfg.ignore_column)
+            # per-row submits in waves: exercises the coalescing path a
+            # live deployment sees, result order preserved
+            results = []
+            pending = []
+            for i in range(X.shape[0]):
+                pending.append(batcher.submit(X[i]))
+                if len(pending) >= min(cfg.serve_queue_depth, 512):
+                    results.extend(batcher.wait(r) for r in pending)
+                    pending = []
+            results.extend(batcher.wait(r) for r in pending)
+            out = np.concatenate([np.asarray(r) for r in results], axis=0)
+            if out.ndim == 1:
+                out = out[:, None]
+            np.savetxt(cfg.output_result, out, delimiter="\t", fmt="%.18g")
+            log_info(f"Finished serving {X.shape[0]} rows; results saved "
+                     f"to {cfg.output_result}")
+        else:
+            for line in sys.stdin:
+                if not line.strip():
+                    continue
+                pred = np.asarray(batcher.predict(_parse_rows(line)))
+                print("\t".join(f"{v:.18g}" for v in pred.reshape(-1)))
+    finally:
+        batcher.stop()
+        registry.stop_watchers()
+        if cfg.serve_metrics_output:
+            metrics.export_json(cfg.serve_metrics_output)
+            log_info(
+                f"Serving metrics saved to {cfg.serve_metrics_output}")
+
+
 def run_convert_model(params: Dict[str, Any], cfg) -> None:
     if not cfg.input_model:
         log_fatal("task=convert_model requires input_model")
@@ -178,6 +325,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         run_predict(params, cfg)
     elif task == "refit":
         run_refit(params, cfg)
+    elif task == "serve":
+        run_serve(params, cfg)
     elif task == "convert_model":
         run_convert_model(params, cfg)
     else:

@@ -144,6 +144,13 @@ _alias("device_profile", "profile", "device_profiling")
 _alias("profile_output", "profile_out", "profile_file")
 _alias("autotune", "auto_tune", "runtime_autotune")
 _alias("autotune_cache", "auto_tune_cache", "autotune_cache_filename")
+_alias("serve_engine", "serving_engine")
+_alias("serve_max_batch", "serving_max_batch")
+_alias("serve_batch_wait_ms", "serve_max_wait_ms", "batch_wait_ms")
+_alias("serve_request_timeout_ms", "serve_timeout_ms")
+_alias("serve_num_shards", "serving_num_shards")
+_alias("serve_watch", "snapshot_watch", "watch_model")
+_alias("serve_metrics_output", "serve_metrics_out", "serving_metrics_file")
 
 
 @dataclass
@@ -281,6 +288,21 @@ class Config:
     # -- convert
     convert_model_language: str = ""
     convert_model: str = "gbdt_prediction.cpp"
+
+    # -- serving (task=serve; lightgbm_tpu/serving/, docs/SERVING.md)
+    serve_engine: str = "auto"         # auto | host | device
+    serve_max_batch: int = 256         # rounded up to a power of two
+    serve_min_bucket: int = 8          # smallest padded batch bucket
+    serve_batch_wait_ms: float = 2.0   # micro-batch coalescing window
+    serve_queue_depth: int = 1024      # request queue bound (back-pressure)
+    serve_request_timeout_ms: float = 1000.0
+    serve_port: int = 0                # > 0: HTTP serving; 0: stdin/file
+    serve_host: str = "127.0.0.1"
+    serve_warmup: bool = True          # pre-compile the bucket ladder
+    serve_num_shards: int = 0          # > 1: shard buckets over devices
+    serve_watch: str = ""              # model prefix to poll for snapshots
+    serve_watch_poll_s: float = 5.0
+    serve_metrics_output: str = ""     # write serving metrics JSON here
 
     # -- objective
     objective_seed: int = 5

@@ -235,6 +235,45 @@ class PackedModel:
         return out
 
     # ------------------------------------------------------------------
+    def device_arrays(self):
+        """Pinned device copies of the packed arrays for the serving
+        engine's jitted lockstep walk (ops/predict.py
+        predict_margin_packed): uploaded ONCE per model version and
+        reused by every compiled bucket trace — the device analog of the
+        host ``_packed_model`` cache. Thresholds are f32-floored
+        (``floor_threshold_f32``) so the device's single-precision
+        compare routes f32 feature values exactly like the host's
+        double-precision walk."""
+        cached = getattr(self, "_device_arrays", None)
+        if cached is not None:
+            return cached
+        if self.has_linear:
+            raise ValueError("device serving path does not support "
+                             "linear leaves; use the host path")
+        import jax.numpy as jnp
+        from ..ops.predict import PackedDeviceArrays
+        pa = PackedDeviceArrays(
+            node_start=jnp.asarray(self.node_start[:-1], jnp.int32),
+            leaf_start=jnp.asarray(self.leaf_start[:-1], jnp.int32),
+            split_feature=jnp.asarray(self.split_feature, jnp.int32),
+            threshold=jnp.asarray(
+                floor_threshold_f32(self.threshold), jnp.float32),
+            threshold_in_bin=jnp.asarray(self.threshold_in_bin, jnp.int32),
+            decision_type=jnp.asarray(self.decision_type, jnp.int32),
+            left_child=jnp.asarray(self.left_child, jnp.int32),
+            right_child=jnp.asarray(self.right_child, jnp.int32),
+            leaf_value=jnp.asarray(self.leaf_value, jnp.float32),
+            single_leaf=jnp.asarray(self.single_leaf),
+            cat_start=jnp.asarray(self.cat_start, jnp.int32),
+            word_start=jnp.asarray(self.word_start, jnp.int32),
+            cat_boundaries=jnp.asarray(self.cat_boundaries, jnp.int32),
+            cat_threshold=jnp.asarray(self.cat_threshold, jnp.uint32),
+            num_cat=int(self.num_cat),
+        )
+        self._device_arrays = pa
+        return pa
+
+    # ------------------------------------------------------------------
     def predict_single(self, x: np.ndarray) -> np.ndarray:
         """[K] margins for ONE row — all trees walk in lockstep, ~depth
         vectorized [T]-sized steps (the FastConfig single-row analog:
@@ -243,6 +282,18 @@ class PackedModel:
         rows = np.zeros(1, np.int64)
         lv = self._leaves(X, rows, np.arange(self.T))[0]  # [T]
         return lv.reshape(self.T // self.K, self.K).sum(axis=0)
+
+
+def floor_threshold_f32(t64: np.ndarray) -> np.ndarray:
+    """The f64 thresholds floored to the largest f32 <= each: for f32
+    feature values v, (v <= thr_f64) == (v <= thr_f32floor), so a device
+    single-precision compare routes boundary rows exactly like the
+    host's double-precision walk."""
+    t64 = np.asarray(t64, np.float64)
+    t32 = t64.astype(np.float32)
+    over = t32.astype(np.float64) > t64
+    t32[over] = np.nextafter(t32[over], np.float32(-np.inf))
+    return t32
 
 
 def _tree_path_tables(tree, M_pad, L_pad, W):
@@ -279,15 +330,7 @@ def _tree_path_tables(tree, M_pad, L_pad, W):
     lv[:n] = tree.leaf_value
     if m > 0:
         feat[:m] = tree.split_feature
-        # the f64 threshold floored to the largest f32 <= it: for f32
-        # feature values v, (v <= thr_f64) == (v <= thr_f32floor), so the
-        # device's single-precision compare routes boundary rows exactly
-        # like the host's double-precision walk
-        t64 = np.asarray(tree.threshold, np.float64)
-        t32 = t64.astype(np.float32)
-        over = t32.astype(np.float64) > t64
-        t32[over] = np.nextafter(t32[over], np.float32(-np.inf))
-        thr[:m] = t32
+        thr[:m] = floor_threshold_f32(tree.threshold)
         dt[:m] = tree.decision_type
         for i in range(m):
             if dt[i] & _CATEGORICAL_MASK:

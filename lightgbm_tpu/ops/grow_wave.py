@@ -1415,8 +1415,42 @@ def grow_tree_wave(
                 hist_v = to_f32(hist_lr)                  # [2K, C, F, B]
                 loc_g = jnp.sum(hist_v[:, 0, 0, :], axis=-1)
                 loc_h = jnp.sum(hist_v[:, 1, 0, :], axis=-1)
-                loc_c = loc_h * (c_lr / jnp.maximum(sh_lr, 1e-12))
-                hist3 = jax.vmap(with_counts)(hist_v, c_lr, sh_lr)
+                # EXACT local child counts: the reference voting learner
+                # screens min_data_in_leaf against each shard's TRUE
+                # local counts (voting_parallel_tree_learner.cpp local
+                # FindBestSplits), so estimating them as
+                # loc_h * (global count / global sum_h) skews the local
+                # vote whenever hessians skew against counts on a shard.
+                # Parent local count by leaf scatter; smaller child's by
+                # candidate-slot scatter of the in-bag row indicator.
+                leafc_loc = jnp.zeros((L,), jnp.float32).at[
+                    jnp.clip(st.leaf_of_row, 0, L - 1)].add(cnt_row)
+                par_loc = jnp.where(valid,
+                                    leafc_loc[jnp.clip(cand, 0, L - 1)],
+                                    0.0)
+                if slot_small is None:
+                    # mega path fused membership into the kernel; redo it
+                    # here (select-chain, voting waves only)
+                    slot_v, in_v, gl_v = table_go_left_bucketed(
+                        n_cand, st.leaf_of_row, cand_tbl, bs.feature,
+                        bs.threshold, bs.default_left,
+                        st.best_is_cat[cand], st.best_bitset[cand])
+                    sil_v = jnp.zeros((N,), bool)
+                    for j in range(KMAX):
+                        sil_v = jnp.where(slot_v == j,
+                                          smaller_is_left[j], sil_v)
+                    slot_small_v = jnp.where(in_v & (gl_v == sil_v),
+                                             slot_v, -1)
+                else:
+                    slot_small_v = slot_small
+                small_loc = jnp.zeros((KMAX + 1,), jnp.float32).at[
+                    jnp.where(slot_small_v >= 0, slot_small_v, KMAX)
+                ].add(cnt_row)[:KMAX]
+                loc_c_left = jnp.where(smaller_is_left, small_loc,
+                                       par_loc - small_loc)
+                loc_c = jnp.concatenate([loc_c_left,
+                                         par_loc - loc_c_left])
+                hist3 = jax.vmap(with_counts)(hist_v, loc_c, loc_h)
                 if bynode:
                     fm_vote = (bn_masks if feature_mask is None
                                else bn_masks & feature_mask[None, :])

@@ -1,18 +1,24 @@
-"""Tree traversal over binned features, on device.
+"""Tree traversal on device: binned (training) and raw (serving).
 
 Vectorized analog of Tree::GetLeaf / NumericalDecisionInner
 (include/LightGBM/tree.h:358-440): all rows walk the tree in lockstep under a
 `lax.while_loop`; each step gathers the current node's split feature column
-and advances. Used for validation-score updates during training and for
-device-side prediction on binned data.
-"""
+and advances. `predict_leaf_binned` runs over binned features for
+validation-score updates during training; `predict_margin_packed` runs the
+same lockstep walk over RAW features and the concatenated packed-tree arrays
+(models/predictor.py PackedModel.device_arrays) — the serving engine's
+compiled scorer, jitted per padded batch bucket so arbitrary request sizes
+hit a warm trace (serving/session.py)."""
 
 from __future__ import annotations
+
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 
-from ..models.tree import MISSING_NAN, MISSING_ZERO
+from ..models.tree import (MISSING_NAN, MISSING_ZERO, _CATEGORICAL_MASK,
+                           _DEFAULT_LEFT_MASK, _KZERO_THRESHOLD)
 from .split import FeatureMeta
 
 
@@ -61,6 +67,87 @@ def predict_leaf_binned(
 
     node = jax.lax.while_loop(cond, body, node0)
     return ~node
+
+
+class PackedDeviceArrays(NamedTuple):
+    """Device-pinned packed multi-tree arrays (flat concatenation over all
+    T trees, models/predictor.py PackedModel layout). `num_cat` is a
+    static python int: models without categorical splits compile the
+    bitset block out entirely."""
+    node_start: jnp.ndarray       # [T] i32 node offset per tree
+    leaf_start: jnp.ndarray       # [T] i32 leaf offset per tree
+    split_feature: jnp.ndarray    # [M] i32
+    threshold: jnp.ndarray        # [M] f32 (f32-floored f64 thresholds)
+    threshold_in_bin: jnp.ndarray  # [M] i32 (categorical bitset index)
+    decision_type: jnp.ndarray    # [M] i32
+    left_child: jnp.ndarray       # [M] i32 (negative = ~leaf)
+    right_child: jnp.ndarray      # [M] i32
+    leaf_value: jnp.ndarray       # [L] f32
+    single_leaf: jnp.ndarray      # [T] bool (stump trees start at leaf 0)
+    cat_start: jnp.ndarray        # [T] i32 into cat_boundaries
+    word_start: jnp.ndarray       # [T] i32 into cat_threshold words
+    cat_boundaries: jnp.ndarray   # i32
+    cat_threshold: jnp.ndarray    # u32 bitset words
+    num_cat: int
+
+
+def predict_margin_packed(pa: PackedDeviceArrays, X: jnp.ndarray,
+                          K: int) -> jnp.ndarray:
+    """[K, n] f32 margins for X [n, F] f32 raw features: every (row,
+    tree) pair walks its tree in lockstep — one vectorized gather step
+    per level under a `while_loop`, ~max-depth steps total (the device
+    analog of PackedModel._leaves, and of the reference's single-row
+    FastConfig walk, c_api.h:1399). Cost per row is O(T * depth) gathers
+    vs the matmul predictor's O(T * L * M) flops, which is the right
+    trade for serving-sized micro-batches. Numeric, missing and
+    categorical splits; linear leaves stay on the host path."""
+    n = X.shape[0]
+    T = pa.node_start.shape[0]
+    # node >= 0: LOCAL internal node to test; node < 0: arrived at ~leaf
+    node0 = jnp.where(pa.single_leaf[None, :], -1, 0) \
+        * jnp.ones((n, 1), jnp.int32)
+    nan_x = jnp.isnan(X)
+
+    def cond(node):
+        return jnp.any(node >= 0)
+
+    def body(node):
+        g = jnp.maximum(node, 0) + pa.node_start[None, :]    # [n, T]
+        f = pa.split_feature[g]
+        fval = jnp.take_along_axis(X, f, axis=1)
+        nan_mask = jnp.take_along_axis(nan_x, f, axis=1)
+        dt = pa.decision_type[g]
+        default_left = (dt & _DEFAULT_LEFT_MASK) != 0
+        mt = (dt >> 2) & 3
+        fval_n = jnp.where(nan_mask & (mt != MISSING_NAN), 0.0, fval)
+        is_missing = ((mt == MISSING_ZERO)
+                      & (jnp.abs(fval_n) <= _KZERO_THRESHOLD)) | \
+                     ((mt == MISSING_NAN) & nan_mask)
+        go_left = jnp.where(is_missing, default_left,
+                            fval_n <= pa.threshold[g])
+        if pa.num_cat > 0:
+            is_cat = (dt & _CATEGORICAL_MASK) != 0
+            valid = ~nan_mask & (fval >= 0)
+            iv = jnp.where(valid, fval, 0).astype(jnp.int32)
+            cb_idx = jnp.clip(
+                pa.cat_start[None, :] + pa.threshold_in_bin[g], 0,
+                jnp.maximum(pa.cat_boundaries.shape[0] - 2, 0))
+            starts = pa.word_start[None, :] + pa.cat_boundaries[cb_idx]
+            sizes = pa.cat_boundaries[cb_idx + 1] - pa.cat_boundaries[cb_idx]
+            in_range = valid & (iv < sizes * 32)
+            word = starts + jnp.minimum(iv >> 5, jnp.maximum(sizes - 1, 0))
+            bits = pa.cat_threshold[
+                jnp.clip(word, 0, pa.cat_threshold.shape[0] - 1)]
+            gl_cat = in_range & (
+                ((bits >> (iv & 31).astype(jnp.uint32)) & 1) == 1)
+            go_left = jnp.where(is_cat, gl_cat, go_left)
+        nxt = jnp.where(go_left, pa.left_child[g], pa.right_child[g])
+        return jnp.where(node >= 0, nxt, node)
+
+    node = jax.lax.while_loop(cond, body, node0)
+    gl = pa.leaf_start[None, :] + ~node                      # [n, T]
+    lv = pa.leaf_value[gl]
+    return lv.reshape(n, T // K, K).sum(axis=1).T            # [K, n]
 
 
 def add_tree_score(

@@ -29,6 +29,19 @@ from ..ops.split import FeatureMeta
 from .context import DATA_AXIS, DistContext
 
 
+def shard_map_compat(f, mesh, in_specs, out_specs, check_vma=False):
+    """`jax.shard_map` appeared (with `check_vma`) well after the
+    experimental API; older jax only has
+    `jax.experimental.shard_map.shard_map(check_rep=...)`. One call site
+    for both, so every mesh builder below works on either."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(f, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=bool(check_vma))
+
+
 def pad_rows_to(n: int, num_shards: int, multiple: int = 8) -> int:
     """Rows must split evenly across shards (and pad to a lane-friendly
     multiple per shard so XLA tiles cleanly)."""
@@ -70,11 +83,31 @@ def build_data_parallel_train_fn(mesh: jax.sharding.Mesh,
     # works a feature slice inside the grower; outputs are replicated
     row = P() if replicate_rows else P(DATA_AXIS)
     rep = P()
-    sharded = jax.shard_map(
+    sharded = shard_map_compat(
         step, mesh=mesh,
         in_specs=((P() if replicate_rows else P(None, DATA_AXIS)),
                   row, row, row, row, rep, rep, rep),
         out_specs=(rep, row, row),
+        check_vma=False)
+    return jax.jit(sharded)
+
+
+def build_sharded_score_fn(mesh: jax.sharding.Mesh, score_fn):
+    """jit(shard_map) wrapper for data-parallel SERVING scoring: request
+    batches shard over the mesh `data` axis, the model (closed over by
+    `score_fn` as pinned device arrays) replicates — the inference-side
+    twin of `build_data_parallel_train_fn`, with no collectives at all
+    (per-row scoring is embarrassingly parallel; the reference's
+    predictor just OMP-parallelizes rows, application/predictor.hpp).
+
+    `score_fn(X [n, F]) -> [K, n]` per shard; the wrapped fn takes a
+    batch whose row count divides the data-axis size (pad with
+    `pad_rows_to`) and returns the full [K, n] on the host mesh.
+    """
+    sharded = shard_map_compat(
+        score_fn, mesh=mesh,
+        in_specs=(P(DATA_AXIS, None),),
+        out_specs=P(None, DATA_AXIS),
         check_vma=False)
     return jax.jit(sharded)
 

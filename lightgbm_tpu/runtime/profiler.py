@@ -250,6 +250,45 @@ class StageProfiler:
         return text
 
 
+class LatencyStats:
+    """Bounded latency reservoir with exact percentiles over the kept
+    tail (most recent ``maxlen`` samples). Shared by the serving metrics
+    (p50/p99 request latency) and any future per-event consumer; totals
+    (count/sum) cover the whole run, percentiles the tail window."""
+
+    def __init__(self, maxlen: int = 8192) -> None:
+        self.buf: collections.deque = collections.deque(maxlen=maxlen)
+        self.count = 0
+        self.total = 0.0
+        self.max_s = 0.0
+
+    def record(self, seconds: float) -> None:
+        self.buf.append(seconds)
+        self.count += 1
+        self.total += seconds
+        if seconds > self.max_s:
+            self.max_s = seconds
+
+    def percentile(self, q: float) -> Optional[float]:
+        """q in [0, 100] over the tail window; None when empty."""
+        if not self.buf:
+            return None
+        s = sorted(self.buf)
+        idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+        return s[idx]
+
+    def to_dict(self) -> Dict[str, Any]:
+        if self.count == 0:
+            return {"count": 0}
+        return {
+            "count": self.count,
+            "mean_ms": round(self.total / self.count * 1e3, 3),
+            "p50_ms": round((self.percentile(50.0) or 0.0) * 1e3, 3),
+            "p99_ms": round((self.percentile(99.0) or 0.0) * 1e3, 3),
+            "max_ms": round(self.max_s * 1e3, 3),
+        }
+
+
 def probe_stage_breakdown(X_t, grad, hess, meta, cfg,
                           n_probe_rows: int = 16384) -> Dict[str, float]:
     """One-time decomposition of the fused grow step into its constituent

@@ -1,0 +1,20 @@
+"""Production inference engine over PackedModel (docs/SERVING.md).
+
+ * session.py  — ServingSession: pinned packed trees, per-bucket compiled
+                 predictor cache, pow2 padding, warmup, sharded scoring
+ * batcher.py  — MicroBatcher: coalesce concurrent small requests
+ * registry.py — ModelRegistry: atomic hot-swap, snapshot watching
+ * metrics.py  — ServingMetrics: QPS / p50 / p99 / occupancy / hit rate,
+                 exported through runtime/profiler JSON
+"""
+
+from .batcher import MicroBatcher, QueueFullError, RequestTimeout
+from .metrics import ServingMetrics
+from .registry import ModelRegistry
+from .session import CompiledPredictorCache, ServingSession, bucket_for
+
+__all__ = [
+    "ServingSession", "CompiledPredictorCache", "bucket_for",
+    "MicroBatcher", "QueueFullError", "RequestTimeout",
+    "ModelRegistry", "ServingMetrics",
+]

@@ -1,0 +1,199 @@
+"""Dynamic micro-batching: coalesce concurrent small requests.
+
+Single-row latency on an accelerator is dominated by fixed dispatch cost, so
+concurrent batch-1 requests are coalesced into one padded-bucket scoring call
+(serving/session.py) under a max-latency / max-batch policy: the worker takes
+the first queued request, then drains more until either the batch is full or
+``max_wait_ms`` has elapsed since the batch opened. One background worker
+thread owns scoring; callers block on a per-request event.
+
+Back-pressure and failure semantics:
+
+ * queue depth is bounded — ``submit`` raises :class:`QueueFullError`
+   immediately when the queue is at ``queue_depth`` requests (fail fast
+   rather than building an unbounded latency backlog);
+ * each request carries a timeout — a caller that gives up marks its
+   request ABANDONED, and the worker drops abandoned requests at batch
+   assembly so their rows aren't scored;
+ * a scoring error is delivered to exactly the requests in that batch;
+   the worker survives and keeps serving.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+
+class QueueFullError(RuntimeError):
+    """Raised by submit() when the request queue is at queue_depth."""
+
+
+class RequestTimeout(TimeoutError):
+    """Raised by wait()/predict() when a request misses its deadline."""
+
+
+class _Request:
+    __slots__ = ("x", "n", "event", "result", "error", "t_enqueue",
+                 "abandoned")
+
+    def __init__(self, x: np.ndarray, t_enqueue: float) -> None:
+        self.x = x
+        self.n = x.shape[0]
+        self.event = threading.Event()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+        self.t_enqueue = t_enqueue
+        self.abandoned = False
+
+
+class MicroBatcher:
+    """Coalesces predict requests into batches for `predict_fn`.
+
+    `predict_fn(X [n, F]) -> per-row outputs` (an array whose FIRST axis
+    is rows, e.g. ``ServingSession.predict``'s output for K == 1, or the
+    [n, K] transposed multiclass output). Results are sliced back per
+    request in submission order.
+    """
+
+    def __init__(self, predict_fn: Callable[[np.ndarray], Any], *,
+                 max_batch: int = 256, max_wait_ms: float = 2.0,
+                 queue_depth: int = 1024, timeout_ms: float = 1000.0,
+                 metrics=None) -> None:
+        self.predict_fn = predict_fn
+        self.max_batch = max(int(max_batch), 1)
+        self.max_wait_s = max(float(max_wait_ms), 0.0) / 1e3
+        self.timeout_s = float(timeout_ms) / 1e3
+        self.metrics = metrics
+        self._q: "queue.Queue[_Request]" = queue.Queue(
+            maxsize=max(int(queue_depth), 1))
+        self._carry: Optional[_Request] = None   # overflow from last batch
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        # observability: sizes of the batches actually scored
+        self.batch_sizes: List[int] = []
+
+    # ------------------------------------------------------------------
+    def start(self) -> "MicroBatcher":
+        if self._running:
+            return self
+        self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="serving-batcher", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        # fail any stragglers so no waiter hangs forever
+        while True:
+            try:
+                r = self._q.get_nowait()
+            except queue.Empty:
+                break
+            r.error = RuntimeError("batcher stopped")
+            r.event.set()
+
+    def __enter__(self) -> "MicroBatcher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------------
+    def submit(self, x) -> _Request:
+        """Enqueue one request (a single row or a small [n, F] block).
+        Non-blocking; raises QueueFullError under back-pressure."""
+        if not self._running:
+            raise RuntimeError("batcher not started")
+        x = np.asarray(x, np.float64)
+        if x.ndim == 1:
+            x = x.reshape(1, -1)
+        req = _Request(x, time.perf_counter())
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            if self.metrics is not None:
+                self.metrics.inc("overflows")
+            raise QueueFullError(
+                f"serving queue full ({self._q.maxsize} requests)") from None
+        return req
+
+    def wait(self, req: _Request, timeout: Optional[float] = None):
+        if timeout is None:
+            timeout = self.timeout_s
+        if not req.event.wait(timeout):
+            req.abandoned = True
+            if self.metrics is not None:
+                self.metrics.inc("timeouts")
+            raise RequestTimeout(
+                f"serving request timed out after {timeout * 1e3:.0f} ms")
+        if req.error is not None:
+            raise req.error
+        if self.metrics is not None:
+            self.metrics.record_request(
+                time.perf_counter() - req.t_enqueue, req.n)
+        return req.result
+
+    def predict(self, x, timeout: Optional[float] = None):
+        """Synchronous submit + wait — the per-request client call."""
+        return self.wait(self.submit(x), timeout)
+
+    # ------------------------------------------------------------------
+    def _gather(self) -> List[_Request]:
+        """The coalescing policy: first request opens the batch; keep
+        draining until max_batch rows or the batch deadline."""
+        if self._carry is not None:
+            first, self._carry = self._carry, None
+        else:
+            try:
+                first = self._q.get(timeout=0.05)
+            except queue.Empty:
+                return []
+        batch = [first]
+        rows = first.n
+        deadline = time.perf_counter() + self.max_wait_s
+        while rows < self.max_batch:
+            rem = deadline - time.perf_counter()
+            try:
+                r = self._q.get(timeout=max(rem, 0.0)) if rem > 0 \
+                    else self._q.get_nowait()
+            except queue.Empty:
+                break
+            if rows + r.n > self.max_batch:
+                self._carry = r          # too big for this batch: next one
+                break
+            batch.append(r)
+            rows += r.n
+        return batch
+
+    def _loop(self) -> None:
+        while self._running:
+            batch = [r for r in self._gather() if not r.abandoned]
+            if not batch:
+                continue
+            X = batch[0].x if len(batch) == 1 else \
+                np.concatenate([r.x for r in batch], axis=0)
+            self.batch_sizes.append(X.shape[0])
+            try:
+                out = self.predict_fn(X)
+            except BaseException as e:   # deliver, don't die
+                if self.metrics is not None:
+                    self.metrics.inc("errors", len(batch))
+                for r in batch:
+                    r.error = e
+                    r.event.set()
+                continue
+            out = np.asarray(out)
+            off = 0
+            for r in batch:
+                r.result = out[off:off + r.n]
+                off += r.n
+                r.event.set()

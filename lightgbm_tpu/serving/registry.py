@@ -1,0 +1,184 @@
+"""Model registry: named sessions, atomic hot-swap, snapshot watching.
+
+``promote`` builds the successor :class:`~.session.ServingSession` COMPLETELY
+(parse, pack, pin, warm the bucket ladder) before a single pointer swap under
+the registry lock, so in-flight requests keep scoring against the old
+session's pinned arrays (Python references keep them alive) and the first
+post-swap request already hits warm traces — a hot-swap never drops or slows
+a request. Sessions share one :class:`~.metrics.ServingMetrics`, so counters
+and latency reservoirs survive swaps.
+
+The snapshot watcher closes the loop with training: ``task=train`` with
+``snapshot_freq=k`` (gbdt.cpp:259-263 analog, cli.py) periodically writes
+``<output_model>.snapshot_iter_<k>.txt``; ``watch_snapshots`` polls that
+prefix and promotes the highest-iteration snapshot it hasn't served yet —
+continuous deployment of a model still being trained.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import threading
+from typing import Any, Dict, Optional
+
+from ..utils.log import log_info
+from .metrics import ServingMetrics
+from .session import ServingSession
+
+_SNAP_RE = re.compile(r"\.snapshot_iter_(\d+)(?:\.txt)?$")
+
+
+def _load_gbdt(model: Any):
+    """Booster | GBDT | model text | model file path -> GBDT."""
+    if hasattr(model, "_gbdt"):                  # Booster
+        return model._gbdt
+    if hasattr(model, "models"):                 # GBDT
+        return model
+    if isinstance(model, (str, os.PathLike)):
+        text = str(model)
+        if "\n" not in text:                     # a path, not model text
+            with open(text) as f:
+                text = f.read()
+        from ..models.gbdt import GBDT
+        return GBDT.load_model_from_string(text)
+    raise TypeError(f"cannot load a model from {type(model).__name__}")
+
+
+class _Watch:
+    __slots__ = ("prefix", "opts", "last_iter", "poll_s", "thread", "stop")
+
+    def __init__(self, prefix: str, opts: Dict[str, Any],
+                 poll_s: float) -> None:
+        self.prefix = prefix
+        self.opts = opts
+        self.last_iter = -1
+        self.poll_s = poll_s
+        self.thread: Optional[threading.Thread] = None
+        self.stop = threading.Event()
+
+
+class ModelRegistry:
+    """name -> live ServingSession, with versioned atomic promotion."""
+
+    def __init__(self, metrics: Optional[ServingMetrics] = None,
+                 **default_session_opts) -> None:
+        self._lock = threading.Lock()
+        self._sessions: Dict[str, ServingSession] = {}
+        self._watches: Dict[str, _Watch] = {}
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self._defaults = default_session_opts
+
+    # ------------------------------------------------------------------
+    def _build(self, model: Any, version: int,
+               opts: Dict[str, Any]) -> ServingSession:
+        kw = dict(self._defaults)
+        kw.update(opts)
+        kw.setdefault("warmup", False)
+        if hasattr(model, "_gbdt") and "num_iteration" not in kw:
+            return ServingSession.from_booster(
+                model, metrics=self.metrics, version=version, **kw)
+        return ServingSession(_load_gbdt(model), metrics=self.metrics,
+                              version=version, **kw)
+
+    def register(self, name: str, model: Any,
+                 **session_opts) -> ServingSession:
+        """First deployment of `name` (or full replacement, version 0)."""
+        sess = self._build(model, 0, session_opts)
+        with self._lock:
+            self._sessions[name] = sess
+        return sess
+
+    def promote(self, name: str, model: Any,
+                **session_opts) -> ServingSession:
+        """Hot-swap: build the successor fully, then one pointer swap."""
+        with self._lock:
+            old = self._sessions.get(name)
+        if old is None:
+            return self.register(name, model, **session_opts)
+        opts = dict(session_opts)
+        for k in ("engine", "max_batch", "min_bucket", "num_shards"):
+            opts.setdefault(k, getattr(
+                old, k if k != "engine" else "requested_engine"))
+        sess = self._build(model, old.version + 1, opts)
+        with self._lock:
+            self._sessions[name] = sess
+        self.metrics.inc("swaps")
+        log_info(f"serving: promoted {name!r} to version {sess.version} "
+                 f"(engine={sess.engine})")
+        return sess
+
+    def session(self, name: str = "default") -> ServingSession:
+        with self._lock:
+            try:
+                return self._sessions[name]
+            except KeyError:
+                raise KeyError(
+                    f"no model {name!r} registered "
+                    f"(have {sorted(self._sessions)})") from None
+
+    def names(self):
+        with self._lock:
+            return sorted(self._sessions)
+
+    def predict(self, data, name: str = "default",
+                raw_score: bool = False):
+        # one pointer read: the whole request scores against ONE version
+        return self.session(name).predict(data, raw_score=raw_score)
+
+    # ------------------------------------------------------------------
+    # snapshot watching
+    # ------------------------------------------------------------------
+    def watch_snapshots(self, name: str, model_prefix: str, *,
+                        poll_s: float = 5.0, start: bool = False,
+                        **session_opts) -> None:
+        """Watch ``<model_prefix>.snapshot_iter_<k>[.txt]`` files and
+        promote new ones. Call :meth:`poll_snapshots` manually (tests,
+        single-threaded serving loops) or pass ``start=True`` for a
+        background poller."""
+        w = _Watch(model_prefix, session_opts, poll_s)
+        with self._lock:
+            self._watches[name] = w
+        if start:
+            w.thread = threading.Thread(
+                target=self._watch_loop, args=(name, w),
+                name=f"snapshot-watch-{name}", daemon=True)
+            w.thread.start()
+
+    def poll_snapshots(self, name: str) -> Optional[int]:
+        """One poll: promote the newest unseen snapshot for `name`.
+        Returns the promoted iteration, or None if nothing new."""
+        with self._lock:
+            w = self._watches.get(name)
+        if w is None:
+            return None
+        best_iter, best_path = w.last_iter, None
+        for path in glob.glob(glob.escape(w.prefix) + ".snapshot_iter_*"):
+            m = _SNAP_RE.search(path)
+            if m and int(m.group(1)) > best_iter:
+                best_iter, best_path = int(m.group(1)), path
+        if best_path is None:
+            return None
+        self.promote(name, best_path, **w.opts)
+        w.last_iter = best_iter
+        log_info(f"serving: picked up snapshot iter {best_iter} "
+                 f"({best_path})")
+        return best_iter
+
+    def _watch_loop(self, name: str, w: _Watch) -> None:
+        while not w.stop.wait(w.poll_s):
+            try:
+                self.poll_snapshots(name)
+            except Exception as e:     # keep watching through bad files
+                self.metrics.inc("errors")
+                log_info(f"serving: snapshot poll failed: {e}")
+
+    def stop_watchers(self) -> None:
+        with self._lock:
+            watches = list(self._watches.values())
+        for w in watches:
+            w.stop.set()
+            if w.thread is not None:
+                w.thread.join(timeout=5.0)
+                w.thread = None

@@ -1,0 +1,304 @@
+"""ServingSession: pinned model + compiled-predictor cache + bucketing.
+
+The reference's online-inference story is the single-row fast path
+(``LGBM_BoosterPredictForMatSingleRowFastInit``, c_api.h:1399-1428): per-call
+setup — config parsing, predictor construction — is hoisted out of the hot
+loop into a reusable FastConfig. This module is that idea rebuilt for an
+accelerator serving loop:
+
+ * the packed tree arrays (models/predictor.py PackedModel) are built once
+   per model version and, for the device engine, pinned in device memory
+   once (``PackedModel.device_arrays``);
+ * request batches are padded up to POWER-OF-TWO buckets, and the compiled
+   scorer for each (model version, engine, bucket) is cached, so arbitrary
+   request sizes hit a warm ``jit`` trace instead of recompiling —
+   ``warmup()`` pre-compiles the whole bucket ladder before traffic lands;
+ * with ``num_shards > 1`` the bucket is scored data-parallel over the
+   existing ``parallel/`` mesh (rows sharded, model replicated — the
+   inference twin of tree_learner=data).
+
+Engines:
+
+ * ``host``  — the PackedModel lockstep walk in f64 numpy. BIT-IDENTICAL
+   to ``Booster.predict`` (same arrays, same arithmetic); the default on
+   CPU backends and the universal fallback (linear leaves).
+ * ``device`` — the jitted f32 lockstep walk (ops/predict.py
+   predict_margin_packed) with f32-floored thresholds: rows route through
+   the trees exactly like the host walk, but leaf-value accumulation is
+   f32, so outputs agree to ~1e-6 relative, not bitwise (docs/SERVING.md).
+ * ``auto``  — device on TPU backends, host elsewhere.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.log import log_info, log_warning
+from .metrics import ServingMetrics
+
+
+def bucket_for(n: int, min_bucket: int, max_bucket: int) -> int:
+    """Smallest power-of-two >= n, clamped to [min_bucket, max_bucket]."""
+    b = 1 << max(int(n) - 1, 0).bit_length()
+    return max(min_bucket, min(b, max_bucket))
+
+
+class CompiledPredictorCache:
+    """(model version, engine, bucket) -> compiled scorer. Thread-safe;
+    hit/miss counts feed the serving cache-hit-rate metric."""
+
+    def __init__(self, metrics: Optional[ServingMetrics] = None) -> None:
+        self._lock = threading.Lock()
+        self._fns: Dict[Tuple, Callable] = {}
+        self.hits = 0
+        self.misses = 0
+        self._metrics = metrics
+
+    def get(self, key: Tuple, builder: Callable[[], Callable]) -> Callable:
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is not None:
+                self.hits += 1
+                if self._metrics is not None:
+                    self._metrics.record_cache(True)
+                return fn
+        # build OUTSIDE the lock (tracing/compiling can be slow); a rare
+        # duplicate build is benign — last writer wins
+        fn = builder()
+        with self._lock:
+            self._fns[key] = fn
+            self.misses += 1
+            if self._metrics is not None:
+                self._metrics.record_cache(False)
+        return fn
+
+    def __len__(self) -> int:
+        return len(self._fns)
+
+
+class ServingSession:
+    """One servable model version: immutable once constructed (hot-swap
+    builds a NEW session, registry.py), safe to score from any thread."""
+
+    def __init__(self, gbdt, *, engine: str = "auto",
+                 max_batch: int = 1024, min_bucket: int = 8,
+                 num_shards: int = 0, start_iteration: int = 0,
+                 num_iteration: int = -1, warmup: bool = False,
+                 metrics: Optional[ServingMetrics] = None,
+                 version: int = 0) -> None:
+        self.gbdt = gbdt
+        self.version = int(version)
+        K = gbdt.num_tree_per_iteration
+        total_iters = len(gbdt.models) // max(K, 1)
+        end = total_iters if num_iteration <= 0 else min(
+            total_iters, start_iteration + num_iteration)
+        self._start = min(start_iteration, total_iters)
+        self._end = max(end, self._start)
+        self.K = K
+        self.num_features = gbdt.max_feature_idx_ + 1
+        # the FastInit analog: pack ONCE, reuse for every request (shares
+        # the gbdt-level cache, so Booster.predict and the session pin
+        # the SAME PackedModel)
+        self._pm = gbdt._packed_model(self._start, self._end)
+        self._avg_div = (self._end - self._start
+                         if gbdt.average_output else 0)
+        self._has_linear = any(getattr(t, "is_linear", False)
+                               for t in gbdt.models)
+
+        self.max_batch = 1 << max(int(max_batch) - 1, 0).bit_length()
+        self.requested_engine = engine
+        self.engine = self._resolve_engine(engine)
+        self.metrics = metrics if metrics is not None else ServingMetrics(
+            max_batch=self.max_batch)
+        if self.metrics.max_batch == 0:
+            self.metrics.max_batch = self.max_batch
+        self._cache = CompiledPredictorCache(self.metrics)
+
+        self.num_shards = 0
+        self._mesh = None
+        if num_shards > 1 and self.engine == "device":
+            import jax
+            avail = len(jax.devices())
+            shards = 1 << (min(int(num_shards), avail).bit_length() - 1)
+            if shards != num_shards:
+                log_warning(f"serving num_shards={num_shards} rounded to "
+                            f"{shards} (power of two, {avail} devices)")
+            if shards > 1:
+                from ..parallel import make_data_mesh
+                self._mesh = make_data_mesh(shards)
+                self.num_shards = shards
+        elif num_shards > 1:
+            log_warning("serving num_shards ignored on the host engine")
+        self.min_bucket = bucket_for(
+            max(int(min_bucket), self.num_shards or 1), 1, self.max_batch)
+        self._lock = threading.Lock()
+        self._device_jit = None
+        if warmup:
+            self.warmup()
+
+    # ------------------------------------------------------------------
+    def _resolve_engine(self, engine: str) -> str:
+        if engine not in ("auto", "host", "device"):
+            raise ValueError(f"unknown serving engine {engine!r}")
+        if engine == "host":
+            return "host"
+        if self._has_linear:
+            # graceful fallback: linear leaves only exist on the host
+            # paths (tree.cpp AddPredictionToScore linear path)
+            if engine == "device":
+                log_warning("serving: model has linear leaves; device "
+                            "engine unavailable, falling back to host")
+            return "host"
+        if engine == "device":
+            return "device"
+        try:
+            import jax
+            backend = jax.default_backend()
+        except Exception:
+            backend = "cpu"
+        return "device" if backend == "tpu" else "host"
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_booster(cls, booster, **kwargs) -> "ServingSession":
+        """Mirror Booster.predict's iteration default: best_iteration
+        when early stopping picked one."""
+        if "num_iteration" not in kwargs:
+            bi = getattr(booster, "best_iteration", -1)
+            kwargs["num_iteration"] = bi if bi and bi > 0 else -1
+        return cls(booster._gbdt, **kwargs)
+
+    @classmethod
+    def from_model_string(cls, model_str: str, **kwargs) -> "ServingSession":
+        from ..models.gbdt import GBDT
+        return cls(GBDT.load_model_from_string(model_str), **kwargs)
+
+    @classmethod
+    def from_file(cls, path: str, **kwargs) -> "ServingSession":
+        with open(path) as f:
+            return cls.from_model_string(f.read(), **kwargs)
+
+    # ------------------------------------------------------------------
+    # compiled scorers
+    # ------------------------------------------------------------------
+    def _device_scorer(self, bucket: int) -> Callable:
+        """Jitted f32 scorer for one padded bucket shape. All buckets
+        share one jitted callable (jax keys traces by shape); the cache
+        entry per bucket is what makes hit/miss == warm/cold trace."""
+        if self._device_jit is None:
+            import jax
+            from ..ops.predict import predict_margin_packed
+            pa = self._pm.device_arrays()
+            K = self.K
+
+            def score(Xp):                       # [b, F] f32 -> [K, b]
+                return predict_margin_packed(pa, Xp, K)
+
+            if self._mesh is not None:
+                from ..parallel import build_sharded_score_fn
+                self._device_jit = build_sharded_score_fn(self._mesh, score)
+            else:
+                self._device_jit = jax.jit(score)
+        return self._device_jit
+
+    def _build_scorer(self, bucket: int) -> Callable:
+        if self.engine == "device":
+            return self._device_scorer(bucket)
+        # host entries are trivially warm closures over the packed model;
+        # they ride the same cache so hit-rate accounting is uniform
+        return self._pm.predict_margin
+
+    def warmup(self) -> List[int]:
+        """Pre-compile the whole bucket ladder (min_bucket..max_batch,
+        powers of two) before traffic lands, so no live request pays a
+        compile. Returns the ladder."""
+        ladder = []
+        b = self.min_bucket
+        while b <= self.max_batch:
+            ladder.append(b)
+            b *= 2
+        F = self.num_features
+        for b in ladder:
+            fn = self._cache.get((self.version, self.engine, b),
+                                 lambda b=b: self._build_scorer(b))
+            if self.engine == "device":
+                import jax
+                out = fn(np.zeros((b, F), np.float32))
+                jax.block_until_ready(out)
+        log_info(f"serving warmup: engine={self.engine} "
+                 f"buckets={ladder} shards={self.num_shards or 1}")
+        return ladder
+
+    # ------------------------------------------------------------------
+    # scoring
+    # ------------------------------------------------------------------
+    def score_margin(self, X: np.ndarray) -> np.ndarray:
+        """[K, n] f64 raw margins for X [n, F] (f64 in, any request
+        size: chunks of up to max_batch, each padded to its bucket)."""
+        X = np.ascontiguousarray(np.asarray(X, np.float64))
+        n = X.shape[0]
+        out = np.empty((self.K, n), np.float64)
+        for c0 in range(0, n, self.max_batch):
+            c1 = min(c0 + self.max_batch, n)
+            m = c1 - c0
+            b = bucket_for(m, self.min_bucket, self.max_batch)
+            fn = self._cache.get((self.version, self.engine, b),
+                                 lambda b=b: self._build_scorer(b))
+            t0 = time.perf_counter()
+            if self.engine == "device":
+                import jax
+                Xp = np.zeros((b, X.shape[1]), np.float32)
+                Xp[:m] = X[c0:c1]
+                r = np.asarray(jax.device_get(fn(Xp)))[:, :m] \
+                    .astype(np.float64)
+            else:
+                # host path scores the exact rows (padding buys nothing
+                # without a shaped trace) — bit-identical to
+                # Booster.predict by construction
+                r = fn(X[c0:c1])
+            self.metrics.record_batch(time.perf_counter() - t0, m)
+            out[:, c0:c1] = r
+        if self._avg_div:
+            out /= self._avg_div
+        return out
+
+    def _postprocess(self, margins: np.ndarray,
+                     raw_score: bool) -> np.ndarray:
+        obj = self.gbdt.objective
+        raw = margins
+        if not raw_score and obj is not None and obj.need_convert_output:
+            raw = obj.convert_output(raw)
+        return raw[0] if raw.shape[0] == 1 else raw.T
+
+    def predict(self, data, raw_score: bool = False) -> np.ndarray:
+        """Score a batch; output shape/semantics match Booster.predict
+        (and on the host engine, the VALUES match bitwise)."""
+        from ..basic import _to_2d_numpy
+        X = _to_2d_numpy(data)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        return self._postprocess(self.score_margin(X), raw_score)
+
+    def predict_single(self, x, raw_score: bool = False) -> Any:
+        """One-row host fast path (~depth lockstep [T] steps, the
+        FastConfig single-row analog) — bypasses bucketing entirely; the
+        universal fallback for models the device path can't serve."""
+        t0 = time.perf_counter()
+        out = self._pm.predict_single(
+            np.asarray(x, np.float64).reshape(-1))
+        if self._avg_div:
+            out = out / self._avg_div
+        self.metrics.record_batch(time.perf_counter() - t0, 1)
+        out = self._postprocess(out[:, None], raw_score)
+        return float(out[0]) if self.K == 1 else out[0]
+
+    # ------------------------------------------------------------------
+    def cache_info(self) -> Dict[str, Any]:
+        return {"entries": len(self._cache), "hits": self._cache.hits,
+                "misses": self._cache.misses, "engine": self.engine,
+                "version": self.version,
+                "num_shards": self.num_shards or 1}

@@ -1,0 +1,116 @@
+"""Serving bench: naive per-call predict() vs micro-batched serving.
+
+Simulates a batch-1 request load: SERVE_CLIENTS concurrent clients each
+fire single-row requests as fast as they can. The naive baseline calls
+``Booster.predict`` once per request (per-call setup every time — the
+anti-pattern the reference's single-row FastInit API exists to avoid);
+the serving path routes the same rows through MicroBatcher ->
+ServingSession (pinned model, warm per-bucket scorers). Emits ONE JSON
+line; also runnable via ``BENCH_SERVING=1 python bench.py``.
+
+Env knobs: SERVE_ROWS/SERVE_COLS/SERVE_TREES (model), SERVE_REQUESTS,
+SERVE_CLIENTS, SERVE_MAX_BATCH, SERVE_WAIT_MS, SERVE_ENGINE.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    rows = int(os.environ.get("SERVE_ROWS", "20000"))
+    cols = int(os.environ.get("SERVE_COLS", "20"))
+    trees = int(os.environ.get("SERVE_TREES", "100"))
+    n_req = int(os.environ.get("SERVE_REQUESTS", "2000"))
+    clients = int(os.environ.get("SERVE_CLIENTS", "16"))
+    max_batch = int(os.environ.get("SERVE_MAX_BATCH", "256"))
+    wait_ms = float(os.environ.get("SERVE_WAIT_MS", "2.0"))
+    engine = os.environ.get("SERVE_ENGINE", "auto")
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serving import MicroBatcher, ServingMetrics
+
+    rng = np.random.RandomState(7)
+    X = rng.normal(size=(rows, cols)).astype(np.float64)
+    w = rng.normal(size=cols)
+    y = (X @ w + rng.normal(scale=0.5, size=rows) > 0).astype(np.float64)
+    booster = lgb.train(
+        dict(objective="binary", num_leaves=63, verbose=-1,
+             learning_rate=0.1),
+        lgb.Dataset(X, label=y), num_boost_round=trees)
+
+    Q = rng.normal(size=(n_req, cols)).astype(np.float64)
+    reference = booster.predict(Q)
+
+    # ---- naive: one Booster.predict call per request, sequential ------
+    booster.predict(Q[:1])                      # absorb any one-off setup
+    t0 = time.perf_counter()
+    naive_out = np.empty(n_req)
+    for i in range(n_req):
+        naive_out[i] = booster.predict(Q[i:i + 1])[0]
+    naive_s = time.perf_counter() - t0
+
+    # ---- served: concurrent batch-1 clients through the batcher -------
+    metrics = ServingMetrics(max_batch=max_batch)
+    sess = booster.serve(engine=engine, max_batch=max_batch,
+                         warmup=True, metrics=metrics)
+    pipeline = int(os.environ.get("SERVE_PIPELINE", "32"))
+    served_out = np.empty(n_req)
+
+    def client(mb, lo, hi):
+        # each client keeps `pipeline` batch-1 requests in flight (what a
+        # serving proxy does), instead of one blocking round-trip at a time
+        for w0 in range(lo, hi, pipeline):
+            w1 = min(w0 + pipeline, hi)
+            reqs = [(i, mb.submit(Q[i])) for i in range(w0, w1)]
+            for i, r in reqs:
+                served_out[i] = mb.wait(r, timeout=30.0)[0]
+
+    with MicroBatcher(sess.predict, max_batch=max_batch,
+                      max_wait_ms=wait_ms, queue_depth=4 * n_req,
+                      timeout_ms=60_000.0, metrics=metrics) as mb:
+        per = -(-n_req // clients)
+        t0 = time.perf_counter()
+        threads = [threading.Thread(
+            target=client, args=(mb, c * per, min((c + 1) * per, n_req)))
+            for c in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        served_s = time.perf_counter() - t0
+        batch_sizes = list(mb.batch_sizes)
+
+    m = metrics.to_dict()["serving"]
+    bit_identical = bool(np.array_equal(served_out, reference)) \
+        if sess.engine == "host" else None
+    out = {
+        "bench": "serving",
+        "engine": sess.engine,
+        "requests": n_req,
+        "clients": clients,
+        "naive_qps": round(n_req / naive_s, 1),
+        "batched_qps": round(n_req / served_s, 1),
+        "speedup": round(naive_s / served_s, 2),
+        "request_p50_ms": m["request_latency"].get("p50_ms"),
+        "request_p99_ms": m["request_latency"].get("p99_ms"),
+        "batch_p50_ms": m["batch_latency"].get("p50_ms"),
+        "cache_hit_rate": m.get("cache_hit_rate"),
+        "mean_batch_rows": round(float(np.mean(batch_sizes)), 1)
+        if batch_sizes else 0.0,
+        "num_batches": len(batch_sizes),
+        "bit_identical_vs_predict": bit_identical,
+        "served_allclose_vs_predict": bool(np.allclose(
+            served_out, reference, rtol=1e-5, atol=1e-7)),
+    }
+    print(json.dumps(out))
+    if bit_identical is False:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -92,4 +92,4 @@ def test_cli_snapshot_freq(reg_data, tmp_path):
               "objective=regression", "verbose=-1"])
     assert out.exists()
     for it in (2, 4, 6):
-        assert (tmp_path / f"model.txt.snapshot_iter_{it}").exists()
+        assert (tmp_path / f"model.txt.snapshot_iter_{it}.txt").exists()

@@ -1,0 +1,372 @@
+"""Serving engine (lightgbm_tpu/serving/): bit-identity across bucket
+boundaries, micro-batching, hot-swap, back-pressure, CLI + HTTP front-ends.
+All CPU-runnable tier-1 (conftest forces JAX_PLATFORMS=cpu, 8 virtual
+devices)."""
+
+import json
+import threading
+import time
+import types
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import (MicroBatcher, ModelRegistry,
+                                  QueueFullError, RequestTimeout,
+                                  ServingMetrics, ServingSession,
+                                  bucket_for)
+
+COLS = 12
+
+
+def _make(rng, n=500, objective="regression", num_boost_round=15, **params):
+    X = rng.normal(size=(n, COLS))
+    if objective == "multiclass":
+        y = (X[:, 0] + 0.3 * rng.normal(size=n) > 0).astype(int) \
+            + (X[:, 1] > 0.5).astype(int)
+        params.setdefault("num_class", 3)
+    elif objective == "binary":
+        y = (X[:, 0] + X[:, 1] > 0).astype(float)
+    else:
+        y = X[:, 0] * 2 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=n)
+    p = dict(objective=objective, num_leaves=15, verbose=-1,
+             min_data_in_leaf=5, **params)
+    return lgb.train(p, lgb.Dataset(X, label=y),
+                     num_boost_round=num_boost_round)
+
+
+@pytest.fixture(scope="module")
+def reg_booster():
+    return _make(np.random.RandomState(0))
+
+
+# a second, distinguishable regression model (more trees): shared by the
+# hot-swap / snapshot / registry tests so each doesn't retrain its own
+@pytest.fixture(scope="module")
+def reg_booster_v2():
+    return _make(np.random.RandomState(0), num_boost_round=30)
+
+
+def test_bucket_for():
+    assert bucket_for(1, 8, 256) == 8
+    assert bucket_for(8, 8, 256) == 8
+    assert bucket_for(9, 8, 256) == 16
+    assert bucket_for(1000, 8, 256) == 256
+    assert bucket_for(129, 8, 256) == 256
+
+
+def test_host_bitwise_identity_across_buckets(reg_booster):
+    """Acceptance: batched serving output bit-identical to
+    Booster.predict at sizes spanning bucket AND chunk boundaries."""
+    rng = np.random.RandomState(1)
+    sess = reg_booster.serve(engine="host", max_batch=256, min_bucket=8)
+    for n in (1, 7, 8, 9, 1000):
+        Xq = rng.normal(size=(n, COLS))
+        assert np.array_equal(sess.predict(Xq), reg_booster.predict(Xq))
+
+
+def test_multiclass_and_raw_score_match(reg_booster):
+    rng = np.random.RandomState(2)
+    mc = _make(rng, objective="multiclass")
+    sess = mc.serve(engine="host")
+    Xq = rng.normal(size=(37, COLS))
+    assert np.array_equal(sess.predict(Xq), mc.predict(Xq))
+    assert np.array_equal(sess.predict(Xq, raw_score=True),
+                          mc.predict(Xq, raw_score=True))
+    # binary: convert_output (sigmoid) path
+    bb = _make(rng, objective="binary")
+    sb = bb.serve(engine="host")
+    assert np.array_equal(sb.predict(Xq), bb.predict(Xq))
+
+
+def test_device_engine_allclose_and_cache(reg_booster):
+    rng = np.random.RandomState(3)
+    metrics = ServingMetrics()
+    sess = reg_booster.serve(engine="device", max_batch=64,
+                             metrics=metrics)
+    assert sess.engine == "device"
+    for n in (5, 30, 5, 30, 64):
+        Xq = rng.normal(size=(n, COLS))
+        got, exp = sess.predict(Xq), reg_booster.predict(Xq)
+        np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-6)
+    # repeat sizes hit warm traces: 3 distinct buckets (8, 32, 64), the
+    # other 2 calls were hits
+    assert sess.cache_info()["misses"] == 3
+    assert sess.cache_info()["hits"] == 2
+    assert metrics.counters["cache_hits"] == 2
+
+
+def test_warmup_precompiles_ladder(reg_booster):
+    sess = reg_booster.serve(engine="device", max_batch=64, min_bucket=8,
+                             warmup=True)
+    ladder = [8, 16, 32, 64]
+    assert sess.cache_info()["entries"] == len(ladder)
+    misses0 = sess.cache_info()["misses"]
+    rng = np.random.RandomState(4)
+    for n in (1, 9, 17, 33, 64):
+        sess.predict(rng.normal(size=(n, COLS)))
+    assert sess.cache_info()["misses"] == misses0   # all warm
+
+
+@pytest.fixture(scope="module")
+def linear_booster():
+    return _make(np.random.RandomState(5), linear_tree=True,
+                 num_boost_round=8)
+
+
+def test_linear_leaf_fallback(linear_booster):
+    rng = np.random.RandomState(5)
+    lb = linear_booster
+    sess = lb.serve(engine="device")    # must gracefully fall back
+    assert sess.engine == "host"
+    Xq = rng.normal(size=(23, COLS))
+    assert np.array_equal(sess.predict(Xq), lb.predict(Xq))
+    assert float(sess.predict_single(Xq[0])) == lb.predict(Xq[:1])[0]
+
+
+def test_device_arrays_rejects_linear(linear_booster):
+    pm = linear_booster._gbdt._packed_model(0, linear_booster.num_trees())
+    with pytest.raises(ValueError):
+        pm.device_arrays()
+
+
+def test_batcher_coalesces_and_matches(reg_booster):
+    rng = np.random.RandomState(7)
+    rows = rng.normal(size=(60, COLS))
+    exp = reg_booster.predict(rows)
+    metrics = ServingMetrics(max_batch=32)
+    sess = reg_booster.serve(engine="host", metrics=metrics)
+    got = np.empty(60)
+
+    with MicroBatcher(sess.predict, max_batch=32, max_wait_ms=20.0,
+                      metrics=metrics) as mb:
+        def go(i):
+            got[i] = mb.predict(rows[i], timeout=30.0)[0]
+        ts = [threading.Thread(target=go, args=(i,)) for i in range(60)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        n_batches = len(mb.batch_sizes)
+        assert sum(mb.batch_sizes) == 60
+    assert np.array_equal(got, exp)
+    assert n_batches < 60                  # actually coalesced
+    assert metrics.counters["requests"] == 60
+    assert metrics.counters["rows"] == 60
+
+
+def test_batcher_timeout():
+    def slow(X):
+        time.sleep(0.5)
+        return np.zeros(X.shape[0])
+
+    metrics = ServingMetrics()
+    with MicroBatcher(slow, max_wait_ms=0.0, timeout_ms=50.0,
+                      metrics=metrics) as mb:
+        with pytest.raises(RequestTimeout):
+            mb.predict(np.zeros(COLS))
+    assert metrics.counters["timeouts"] == 1
+
+
+def test_batcher_queue_overflow():
+    release = threading.Event()
+
+    def block(X):
+        release.wait(5.0)
+        return np.zeros(X.shape[0])
+
+    metrics = ServingMetrics()
+    mb = MicroBatcher(block, max_wait_ms=0.0, queue_depth=2,
+                      metrics=metrics).start()
+    try:
+        reqs = [mb.submit(np.zeros(COLS))]
+        time.sleep(0.1)                    # worker picks up req 0, blocks
+        reqs.append(mb.submit(np.zeros(COLS)))
+        reqs.append(mb.submit(np.zeros(COLS)))
+        with pytest.raises(QueueFullError):
+            mb.submit(np.zeros(COLS))      # 2 queued + 1 in flight
+        assert metrics.counters["overflows"] == 1
+    finally:
+        release.set()
+        mb.stop()
+
+
+def test_batcher_delivers_errors():
+    def boom(X):
+        raise RuntimeError("scorer exploded")
+
+    with MicroBatcher(boom, max_wait_ms=0.0) as mb:
+        with pytest.raises(RuntimeError, match="scorer exploded"):
+            mb.predict(np.zeros(COLS))
+        # worker survived the error and keeps serving
+        with pytest.raises(RuntimeError, match="scorer exploded"):
+            mb.predict(np.zeros(COLS))
+
+
+def test_registry_hot_swap_under_concurrent_requests(reg_booster,
+                                                     reg_booster_v2):
+    rng = np.random.RandomState(8)
+    b1, b2 = reg_booster, reg_booster_v2
+    rows = rng.normal(size=(40, COLS))
+    p1, p2 = b1.predict(rows), b2.predict(rows)
+
+    reg = ModelRegistry(engine="host")
+    reg.register("m", b1)
+    assert reg.session("m").version == 0
+    stop = threading.Event()
+    bad = []
+
+    def hammer():
+        while not stop.is_set():
+            out = reg.predict(rows, name="m")
+            # every response must be ENTIRELY one version's answer
+            if not (np.array_equal(out, p1) or np.array_equal(out, p2)):
+                bad.append(out)
+
+    ts = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in ts:
+        t.start()
+    time.sleep(0.05)
+    reg.promote("m", b2)                   # atomic swap mid-traffic
+    time.sleep(0.05)
+    stop.set()
+    for t in ts:
+        t.join()
+    assert not bad
+    assert reg.session("m").version == 1
+    assert reg.metrics.counters["swaps"] == 1
+    assert np.array_equal(reg.predict(rows, name="m"), p2)
+
+
+def test_registry_loads_model_string_and_file(tmp_path, reg_booster):
+    rng = np.random.RandomState(9)
+    b = reg_booster
+    path = tmp_path / "m.txt"
+    b.save_model(str(path))
+    reg = ModelRegistry(engine="host")
+    reg.register("from_str", b.model_to_string())
+    reg.register("from_file", str(path))
+    rows = rng.normal(size=(11, COLS))
+    exp = b.predict(rows)
+    assert np.array_equal(reg.predict(rows, name="from_str"), exp)
+    assert np.array_equal(reg.predict(rows, name="from_file"), exp)
+    with pytest.raises(KeyError):
+        reg.session("nope")
+
+
+def test_snapshot_watch_promotes_newest(tmp_path, reg_booster,
+                                        reg_booster_v2):
+    rng = np.random.RandomState(10)
+    b1, b2 = reg_booster, reg_booster_v2
+    prefix = str(tmp_path / "model.txt")
+    b2.save_model(prefix + ".snapshot_iter_4.txt")
+    b1.save_model(prefix + ".snapshot_iter_2.txt")
+
+    reg = ModelRegistry(engine="host")
+    reg.register("m", b1)
+    reg.watch_snapshots("m", prefix)
+    assert reg.poll_snapshots("m") == 4    # newest snapshot wins
+    rows = rng.normal(size=(9, COLS))
+    assert np.array_equal(reg.predict(rows, name="m"), b2.predict(rows))
+    assert reg.poll_snapshots("m") is None  # nothing newer
+
+
+def test_sharded_device_scoring_matches(reg_booster):
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    rng = np.random.RandomState(11)
+    sess = reg_booster.serve(engine="device", max_batch=64, num_shards=2)
+    assert sess.num_shards == 2
+    for n in (1, 13, 64, 150):
+        Xq = rng.normal(size=(n, COLS))
+        np.testing.assert_allclose(sess.predict(Xq),
+                                   reg_booster.predict(Xq),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_metrics_export_json(tmp_path, reg_booster):
+    rng = np.random.RandomState(12)
+    metrics = ServingMetrics(max_batch=32)
+    sess = reg_booster.serve(engine="host", max_batch=32, metrics=metrics)
+    sess.predict(rng.normal(size=(20, COLS)))
+    metrics.record_request(0.002, 20)
+    path = tmp_path / "serving.json"
+    metrics.export_json(str(path))
+    d = json.loads(path.read_text())
+    s = d["serving"]
+    assert s["counters"]["batches"] == 1
+    assert s["counters"]["requests"] == 1
+    assert s["batch_latency"]["count"] == 1
+    assert "p99_ms" in s["request_latency"]
+    assert 0 < s["batch_occupancy"] <= 1.0
+
+
+def test_cli_serve_file_matches_task_predict(tmp_path):
+    rng = np.random.RandomState(13)
+    X = rng.normal(size=(200, 6))
+    y = X[:, 0] + 0.1 * rng.normal(size=200)
+    train = tmp_path / "train.csv"
+    np.savetxt(train, np.column_stack([y, X]), delimiter=",")
+    model = tmp_path / "model.txt"
+    from lightgbm_tpu.cli import main as cli_main
+    cli_main(["task=train", f"data={train}", "header=false",
+              "label_column=0", f"output_model={model}",
+              "num_iterations=8", "num_leaves=7",
+              "objective=regression", "verbose=-1"])
+    query = tmp_path / "query.csv"
+    np.savetxt(query, np.column_stack([np.zeros(50),
+                                       rng.normal(size=(50, 6))]),
+               delimiter=",")
+    out_pred = tmp_path / "pred.tsv"
+    out_serve = tmp_path / "serve.tsv"
+    cli_main(["task=predict", f"data={query}", "header=false",
+              "label_column=0", f"input_model={model}",
+              f"output_result={out_pred}", "verbose=-1"])
+    cli_main(["task=serve", f"data={query}", "header=false",
+              "label_column=0", f"input_model={model}",
+              "serve_engine=host", "serve_max_batch=16",
+              f"serve_metrics_output={tmp_path / 'metrics.json'}",
+              f"output_result={out_serve}", "verbose=-1"])
+    # the serve path writes the SAME bytes task=predict does
+    assert out_serve.read_text() == out_pred.read_text()
+    m = json.loads((tmp_path / "metrics.json").read_text())["serving"]
+    assert m["counters"]["requests"] == 50
+
+
+def test_http_server_roundtrip(reg_booster):
+    rng = np.random.RandomState(14)
+    from lightgbm_tpu.cli import build_http_server
+    metrics = ServingMetrics(max_batch=32)
+    reg = ModelRegistry(metrics=metrics, engine="host", max_batch=32)
+    reg.register("default", reg_booster)
+    cfg = types.SimpleNamespace(serve_host="127.0.0.1", serve_port=0)
+    with MicroBatcher(lambda X: reg.predict(X), max_batch=32,
+                      max_wait_ms=1.0, metrics=metrics) as mb:
+        server = build_http_server(cfg, reg, mb, metrics)
+        host, port = server.server_address
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            rows = rng.normal(size=(3, COLS))
+            body = json.dumps({"rows": rows.tolist()}).encode()
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/predict", data=body,
+                    timeout=10) as resp:
+                pred = json.loads(resp.read())["predictions"]
+            assert np.array_equal(np.asarray(pred),
+                                  reg_booster.predict(rows))
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/metrics", timeout=10) as resp:
+                m = json.loads(resp.read())
+            assert m["serving"]["counters"]["requests"] == 1
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/health", timeout=10) as resp:
+                h = json.loads(resp.read())
+            assert h["status"] == "ok" and h["models"] == ["default"]
+        finally:
+            server.shutdown()
+            server.server_close()
+            t.join(timeout=5)
