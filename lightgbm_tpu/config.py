@@ -87,6 +87,7 @@ _alias("min_data_in_bin", "min_data_in_bin")
 _alias("bin_construct_sample_cnt", "bin_construct_sample_cnt",
        "subsample_for_bin")
 _alias("data_random_seed", "data_seed")
+_alias("histogram_impl", "hist_impl", "tpu_histogram_impl")
 _alias("is_enable_sparse", "is_sparse", "enable_sparse", "sparse")
 _alias("enable_bundle", "is_enable_bundle", "bundle")
 _alias("use_missing", "use_missing")
@@ -361,6 +362,13 @@ class Config:
     autotune_cache: str = ""           # decision cache path ("" = env
     #                                    LIGHTGBM_TPU_AUTOTUNE_CACHE or
     #                                    ~/.cache/lightgbm_tpu/autotune.json)
+    # bin-width-tiered histogram construction (docs/PERF.md):
+    #   auto        tier by width class; hi/lo wide-bin variant; autotune
+    #               may override per device/shape
+    #   legacy      uniform widest-feature kernel (pre-tiering behavior)
+    #   tiered      per-class kernels, legacy 128-wide hi/lo split
+    #   tiered_hilo per-class kernels + 64-wide hi/lo wide-bin variant
+    histogram_impl: str = "auto"
 
     def __post_init__(self) -> None:
         self._validate()
@@ -386,6 +394,22 @@ class Config:
                 log_fatal(
                     "Random forest (boosting=rf) requires 0 < bagging_fraction < 1 "
                     "and bagging_freq > 0")
+        # the reference silently treats unknown values as "basic"
+        # (monotone_constraints.hpp); failing fast is kinder — "advanced"
+        # in particular is NOT implemented here (docs/PARITY.md)
+        if self.monotone_constraints_method not in ("basic",
+                                                    "intermediate"):
+            log_fatal(
+                "Unknown/unsupported monotone_constraints_method "
+                f"'{self.monotone_constraints_method}' (supported: "
+                "'basic', 'intermediate'; the reference's 'advanced' "
+                "method is not implemented — see docs/PARITY.md)")
+        if self.histogram_impl not in ("auto", "legacy", "tiered",
+                                       "tiered_hilo"):
+            log_fatal(
+                f"Unknown histogram_impl '{self.histogram_impl}' "
+                "(supported: 'auto', 'legacy', 'tiered', 'tiered_hilo'; "
+                "see docs/PERF.md)")
 
     def max_depth_effective(self) -> int:
         return self.max_depth if self.max_depth > 0 else 10**9

@@ -121,6 +121,13 @@ class BinnedDataset:
         # them at fit time (reference keeps Dataset raw_data the same way,
         # linear_tree_learner.cpp raw_index)
         self.raw_data: Optional[np.ndarray] = None
+        # bin-width tier permutation (docs/PERF.md): tier_perm[new_inner]
+        # = pre-sort inner index. Inner features are stably reordered by
+        # histogram lane-width class (<=32/<=64/<=128/<=256 bins) at
+        # construction so same-width features are contiguous and
+        # ops/histogram_tiered.py can size one kernel per class. None =
+        # reorder not applied (old binary caches before re-load).
+        self.tier_perm: Optional[List[int]] = None
 
     # -- derived per-feature arrays consumed by device kernels
     @property
@@ -163,6 +170,46 @@ def _init_ds(num_data: int, num_cols: int, config: Config,
     return ds
 
 
+def _lane_width(num_bin: int) -> int:
+    """Histogram kernel lane-width class for a feature (numpy-level twin
+    of ops/histogram_tiered.lane_width — duplicated so data loading never
+    imports jax). >256 bins means uint16 storage, which the Pallas path
+    rejects anyway; those features form their own trailing class."""
+    for w in (32, 64, 128, 256):
+        if num_bin <= w:
+            return w
+    return 512
+
+
+def _apply_tier_order(ds: BinnedDataset,
+                      reorder_binned: bool = False) -> None:
+    """Stably reorder inner features by lane-width class (docs/PERF.md)
+    and record the permutation in `ds.tier_perm`.
+
+    Runs BEFORE the binning loop in the normal constructors (columns are
+    then binned directly into tier order via `real_feature_index`), so
+    only the three mapping tables move; `reorder_binned=True` (binary
+    cache load) additionally permutes the already-binned columns. All
+    consumers address features through `used_feature_map` /
+    `real_feature_index`, so the reorder is invisible outside histogram
+    kernel-launch grouping — except that equal-gain split ties, which
+    resolve by lowest inner index, can pick a different (equally valid)
+    feature on mixed-width datasets."""
+    F = len(ds.mappers)
+    perm = sorted(range(F),
+                  key=lambda f: _lane_width(ds.mappers[f].num_bin))
+    ds.tier_perm = perm
+    if perm == list(range(F)):
+        return
+    ds.mappers = [ds.mappers[p] for p in perm]
+    ds.real_feature_index = [ds.real_feature_index[p] for p in perm]
+    for new_inner, orig in enumerate(ds.real_feature_index):
+        ds.used_feature_map[orig] = new_inner
+    if reorder_binned and ds.X_binned is not None \
+            and ds.X_binned.shape[1] == F:
+        ds.X_binned = np.ascontiguousarray(ds.X_binned[:, perm])
+
+
 def _fit_or_adopt_mappers(ds: BinnedDataset, config: Config,
                           reference: Optional[BinnedDataset],
                           sample_col, n_sample: int,
@@ -175,6 +222,7 @@ def _fit_or_adopt_mappers(ds: BinnedDataset, config: Config,
         ds.mappers = reference.mappers
         ds.real_feature_index = reference.real_feature_index
         ds.used_feature_map = reference.used_feature_map
+        ds.tier_perm = reference.tier_perm
         ds.reference = reference
         return
     num_cols = ds.num_total_features
@@ -197,6 +245,7 @@ def _fit_or_adopt_mappers(ds: BinnedDataset, config: Config,
                 ds.used_feature_map.append(len(ds.mappers))
                 ds.mappers.append(m)
                 ds.real_feature_index.append(j)
+        _apply_tier_order(ds)
         return
     max_bins = list(config.max_bin_by_feature) if config.max_bin_by_feature \
         else [config.max_bin] * num_cols
@@ -223,6 +272,7 @@ def _fit_or_adopt_mappers(ds: BinnedDataset, config: Config,
         log_warning("There are no meaningful features which satisfy the "
                     "provided configuration. Decrease min_data_in_bin or "
                     "check the data.")
+    _apply_tier_order(ds)
 
 
 def _alloc_binned(ds: BinnedDataset) -> np.ndarray:
@@ -442,6 +492,9 @@ def load_binary_file(path: str, config: Config) -> BinnedDataset:
     if "init_score" in z.files and z["init_score"].size:
         md.set_init_score(z["init_score"])
     ds.metadata = md
+    # caches written before the tier reorder existed hold original-order
+    # columns; re-applying to a tier-ordered cache is the identity
+    _apply_tier_order(ds, reorder_binned=True)
     if (config.enable_bundle and config.boosting in ("gbdt", "gbrt")
             and config.tpu_grower in ("auto", "wave", "wave_exact")):
         _build_bundles(ds, config)
@@ -508,6 +561,11 @@ def _build_bundles(ds: BinnedDataset, config: Config) -> None:
     n_bundled = sum(1 for g in groups if len(g["members"]) > 1)
     if n_bundled == 0:
         return
+    # stable-sort bundle columns by histogram lane-width class so the
+    # bundled storage keeps the tier-contiguity the inner-feature reorder
+    # established (docs/PERF.md); g["bins"] is the column's bin count for
+    # singletons and multi-bundles alike
+    groups.sort(key=lambda g: _lane_width(g["bins"]))
     bundle_col = np.zeros(F, np.int32)
     bundle_off = np.full(F, -1, np.int32)
     cols = []

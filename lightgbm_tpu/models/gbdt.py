@@ -310,6 +310,19 @@ class GBDT:
             log_fatal("monotone_constraints_method="
                       f"{cfg.monotone_constraints_method} is not "
                       "implemented (use 'basic' or 'intermediate')")
+        # per-STORAGE-COLUMN bin counts for the bin-width-tiered histogram
+        # path (ops/histogram_tiered.py, docs/PERF.md): bundled storage
+        # counts each bundle column's packed width, raw storage the mapper
+        # widths; the dataset's tier reorder made same-width columns
+        # contiguous
+        if self._use_bundles:
+            hist_tiers = tuple(
+                int(ds.mappers[members[0]].num_bin) if len(members) == 1
+                else 1 + sum(int(ds.mappers[f].num_bin) - 1
+                             for f in members)
+                for members in ds.bundles)
+        else:
+            hist_tiers = tuple(int(m.num_bin) for m in ds.mappers)
         self.grow_cfg = GrowConfig(
             num_leaves=cfg.num_leaves,
             max_depth=cfg.max_depth,
@@ -351,6 +364,8 @@ class GBDT:
             monotone_method=str(cfg.monotone_constraints_method),
             monotone_penalty=float(cfg.monotone_penalty),
             feature_parallel=self._feat_par,
+            hist_tiers=hist_tiers,
+            hist_impl=str(cfg.histogram_impl),
         )
 
         # grower selection: "wave" (default via auto) applies batched
@@ -578,10 +593,44 @@ class GBDT:
                 if rc > 0 and rc != self.grow_cfg.rows_per_chunk:
                     self.grow_cfg = self.grow_cfg._replace(
                         rows_per_chunk=rc)
+                hist_impl = decision.get("hist_impl")
+                if hist_impl and hist_impl != self.grow_cfg.hist_impl:
+                    log_info("autotune: probes picked histogram impl "
+                             f"'{hist_impl}'")
+                    self.grow_cfg = self.grow_cfg._replace(
+                        hist_impl=str(hist_impl))
                 if self.profiler is not None:
                     self.profiler.extras["autotune"] = decision
 
+        if self.profiler is not None and self.grow_cfg.hist_tiers:
+            self._profile_hist_tiers()
+
         self._build_jit_fns()
+
+    def _profile_hist_tiers(self) -> None:
+        """Record the dataset's width-class structure and one stage span
+        per class (hist_class_b{lane}) so device_profile output shows how
+        the histogram pass splits across bin-width tiers (docs/PERF.md).
+        Probes a row subsample of the resident binned matrix; skipped on
+        meshes (X_t is sharded and the probe would only fence shard 0)."""
+        from ..ops.histogram import build_histogram
+        from ..ops.histogram_tiered import build_tier_plan
+        if max(self.grow_cfg.hist_tiers) > 256:
+            return          # uint16 storage: no Pallas path, no tiers
+        plan = build_tier_plan(
+            tuple(int(t) for t in self.grow_cfg.hist_tiers))
+        self.profiler.extras["hist_tiers"] = [
+            {"start": s, "count": c, "lane_bins": w}
+            for (s, c, w) in plan.classes]
+        self.profiler.extras["hist_impl"] = self.grow_cfg.hist_impl
+        if self.use_dist:
+            return
+        n_probe = int(min(self.N_pad, 65536))
+        vals = jnp.ones((2, n_probe), jnp.float32)
+        for (s, c, w) in plan.classes:
+            with self._prof_span(f"hist_class_b{w}"):
+                build_histogram(self.X_t[s:s + c, :n_probe], vals,
+                                min(self.num_bins_padded, w))
 
     def _prof_span(self, name: str):
         """The active profiler's span, or a no-op context."""

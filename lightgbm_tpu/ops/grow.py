@@ -55,6 +55,13 @@ class GrowConfig(NamedTuple):
     path_smooth: float
     num_bins_padded: int        # B: padded bin axis
     rows_per_chunk: int = 8192
+    # bin-width-tiered histogram path (ops/histogram_tiered.py,
+    # docs/PERF.md): per-STORAGE-COLUMN bin counts in storage order
+    # (empty = legacy uniform kernel) and the implementation selector
+    # ("auto" | "legacy" | "tiered" | "tiered_hilo" —
+    # config.histogram_impl, possibly overridden by runtime/autotune.py)
+    hist_tiers: tuple = ()
+    hist_impl: str = "auto"
     # categorical split search (reference: config.h cat_* params)
     has_categorical: bool = False
     max_cat_to_onehot: int = 4
@@ -268,7 +275,8 @@ def grow_tree(
         vals = jnp.stack([g * ind_l, h * ind_l,
                           g * ind_r, h * ind_r],
                          axis=0)                                 # [4, N]
-        hist4 = build_histogram(X_t, vals, B, cfg.rows_per_chunk)
+        hist4 = build_histogram(X_t, vals, B, cfg.rows_per_chunk,
+                                tiers=cfg.hist_tiers, impl=cfg.hist_impl)
         hist4 = psum(hist4)
         return hist4[:2], hist4[2:]
 
@@ -301,7 +309,9 @@ def grow_tree(
         / (root_h + hp.lambda_l2), jnp.float32)
 
     vals0 = jnp.stack([g, h], axis=0)
-    hist_root = psum(build_histogram(X_t, vals0, B, cfg.rows_per_chunk))
+    hist_root = psum(build_histogram(X_t, vals0, B, cfg.rows_per_chunk,
+                                     tiers=cfg.hist_tiers,
+                                     impl=cfg.hist_impl))
     root_split, root_is_cat, root_bitset = search(
         hist_root, root_g, root_h, root_c, root_out)
     root_split = root_split._replace(

@@ -134,7 +134,9 @@ def grow_tree_fast(
         / (root_h + hp.lambda_l2), jnp.float32)
 
     vals0 = jnp.stack([g, h], axis=0)
-    hist_root = psum(build_histogram(X_t, vals0, B, cfg.rows_per_chunk))
+    hist_root = psum(build_histogram(X_t, vals0, B, cfg.rows_per_chunk,
+                                     tiers=cfg.hist_tiers,
+                                     impl=cfg.hist_impl))
     root_split, root_is_cat, root_bitset = search(
         hist_root, root_g, root_h, root_c, root_out)
     root_split = root_split._replace(
@@ -233,7 +235,9 @@ def grow_tree_fast(
             Xg = jnp.take(X_t, idx, axis=1)                          # [F, S]
             vals = jnp.stack([grad[idx].astype(jnp.float32) * m,
                               hess[idx].astype(jnp.float32) * m], axis=0)
-            hist_small = build_histogram(Xg, vals, B, cfg.rows_per_chunk)
+            hist_small = build_histogram(Xg, vals, B, cfg.rows_per_chunk,
+                                         tiers=cfg.hist_tiers,
+                                         impl=cfg.hist_impl)
             return order, n_left, hist_small
 
         return branch

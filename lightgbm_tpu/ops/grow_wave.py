@@ -223,8 +223,15 @@ def grow_tree_wave(
         kcap = 3_400_000 // (C_stat * 32 * B_lane * 4)
         kcap = max(1 << (kcap.bit_length() - 1), 1) if kcap >= 1 else 1
         buckets = _wave_buckets(L, min(kcap, 128))
+        # wide-bin megakernel waves run the hi/lo one-hot decomposition
+        # (histogram_pallas._compute_dims wide_lo, docs/PERF.md) unless
+        # config/autotune pinned the legacy split. VMEM budget is
+        # unchanged: HB*LO = B_lane for either choice, so kcap holds.
+        mega_wide_lo = 64 if (B_lane > 128 and cfg.hist_impl
+                              in ("auto", "tiered_hilo")) else 128
     else:
         buckets = _wave_buckets(L)
+        mega_wide_lo = 128
     KMAX = buckets[-1]
 
     # feature-parallel holds the FULL data on every shard: row-statistic
@@ -616,7 +623,9 @@ def grow_tree_wave(
     # feature-parallel builds the root on its feature slice only (the
     # whole point of the learner: 1/n of the histogram work per shard)
     hist_root_local = build_histogram(X_hist if fp else X_t, vals0, B,
-                                      cfg.rows_per_chunk)
+                                      cfg.rows_per_chunk,
+                                      tiers=cfg.hist_tiers,
+                                      impl=cfg.hist_impl)
     hist_root = psum(hist_root_local)
     root_fid = jnp.asarray(0 if has_forced else -1, jnp.int32)
     used0 = (cegb_used if has_cegb else jnp.zeros((F,), bool))
@@ -838,7 +847,9 @@ def grow_tree_wave(
     def make_hist_branch(K):
         def branch(slot_small):
             hist = build_histogram_slots(X_hist, vals0, slot_small, K, B,
-                                         cfg.rows_per_chunk)
+                                         cfg.rows_per_chunk,
+                                         tiers=cfg.hist_tiers,
+                                         impl=cfg.hist_impl)
             if K < KMAX:
                 hist = jnp.pad(hist, ((0, KMAX - K), (0, 0), (0, 0), (0, 0)))
             return hist
@@ -870,7 +881,8 @@ def grow_tree_wave(
             def branch(args):
                 lor, tbl16 = args
                 new_lor, hist = wave_pass_pallas(X_mega, vals_mega, lor,
-                                                 tbl16, K, B)
+                                                 tbl16, K, B,
+                                                 wide_lo=mega_wide_lo)
                 hist = hist[:, :, :F0, :]
                 if K < KMAX:
                     hist = jnp.pad(

@@ -54,21 +54,69 @@ def _use_pallas(X_binned_t: jnp.ndarray, num_bins: int) -> bool:
         return False
 
 
+def _tier_route(tiers, F: int, num_bins: int, impl: str):
+    """Decide how a Pallas histogram call runs (docs/PERF.md).
+
+    `tiers` is the per-STORAGE-COLUMN bin count tuple in storage order
+    (GrowConfig.hist_tiers); `impl` is one of "auto" / "legacy" /
+    "tiered" / "tiered_hilo" (config.histogram_impl, possibly
+    overridden by runtime/autotune.py).
+
+    Returns None (uniform legacy kernel, caller's num_bins), or
+    ("legacy", eff_bins, wide_lo) — single width class: one kernel
+    sized to the class lane width (zero-padded back up to num_bins),
+    with the hi/lo wide-bin variant when eligible — or
+    ("tiered", plan, hilo) for the multi-class flat-offset path.
+
+    The `len(tiers) != F` guard keeps callers that slice the feature
+    axis (feature-parallel shards, compile-warm dummy calls) on the
+    legacy kernel rather than mis-applying a full-width plan."""
+    if impl == "legacy" or not tiers or len(tiers) != F \
+            or max(tiers) > 256:
+        return None
+    from .histogram_tiered import build_tier_plan, class_wide_lo
+    plan = build_tier_plan(tuple(int(t) for t in tiers))
+    hilo = impl in ("auto", "tiered_hilo")
+    if len(plan.classes) == 1:
+        lane_B = plan.classes[0][2]
+        eff = min(num_bins, lane_B)
+        return ("legacy", eff, class_wide_lo(lane_B, hilo))
+    return ("tiered", plan, hilo)
+
+
 def build_histogram(
     X_binned_t: jnp.ndarray,   # [F, N] uint8/uint16/int32 (feature-major)
     vals: jnp.ndarray,         # [C, N] float32 (already masked for leaf/bag)
     num_bins: int,             # B: padded bin-axis size (static)
     rows_per_chunk: int = 8192,
     dtype=jnp.float32,
+    *,
+    tiers: tuple = (),
+    impl: str = "auto",
 ) -> jnp.ndarray:
     """Dense one-hot-matmul histogram: returns [C, F, B] float32.
 
     `vals` must already be masked (zeroed) for rows outside the target leaf /
-    bag.
+    bag. `tiers`/`impl` select the bin-width-tiered Pallas path
+    (_tier_route); the XLA lowering ignores them (its one-hot is already
+    sized by `num_bins` alone, and it is the pinned test reference).
     """
     if _use_pallas(X_binned_t, num_bins):
         from .histogram_pallas import build_histogram_pallas
-        return build_histogram_pallas(X_binned_t, vals, num_bins)
+        route = _tier_route(tiers, X_binned_t.shape[0], num_bins, impl)
+        if route is None:
+            return build_histogram_pallas(X_binned_t, vals, num_bins)
+        if route[0] == "legacy":
+            _, eff, wide_lo = route
+            h = build_histogram_pallas(X_binned_t, vals, eff,
+                                       wide_lo=wide_lo)
+            if eff < num_bins:
+                h = jnp.pad(h, ((0, 0), (0, 0), (0, num_bins - eff)))
+            return h
+        from .histogram_tiered import build_histogram_tiered
+        _, plan, hilo = route
+        return build_histogram_tiered(X_binned_t, vals, num_bins, plan,
+                                      hilo=hilo)
     return _build_histogram_xla(X_binned_t, vals, num_bins, rows_per_chunk,
                                 dtype)
 
@@ -81,12 +129,34 @@ def build_histogram_slots(
     num_slots: int,            # K (static)
     num_bins: int,             # B (static)
     rows_per_chunk: int = 8192,
+    *,
+    tiers: tuple = (),
+    impl: str = "auto",
 ) -> jnp.ndarray:
-    """Wave histogram: returns [K, C, F, B] float32."""
+    """Wave histogram: returns [K, C, F, B] float32.
+
+    `tiers`/`impl` select the bin-width-tiered Pallas path exactly as in
+    `build_histogram` (docs/PERF.md)."""
     if _use_pallas(X_binned_t, num_bins):
         from .histogram_pallas import build_histogram_slots_pallas
-        return build_histogram_slots_pallas(X_binned_t, vals, slot,
-                                            num_slots, num_bins)
+        route = _tier_route(tiers, X_binned_t.shape[0], num_bins, impl)
+        if route is None:
+            return build_histogram_slots_pallas(X_binned_t, vals, slot,
+                                                num_slots, num_bins)
+        if route[0] == "legacy":
+            _, eff, wide_lo = route
+            h = build_histogram_slots_pallas(X_binned_t, vals, slot,
+                                             num_slots, eff,
+                                             wide_lo=wide_lo)
+            if eff < num_bins:
+                h = jnp.pad(h, ((0, 0), (0, 0), (0, 0),
+                                (0, num_bins - eff)))
+            return h
+        from .histogram_tiered import build_histogram_slots_tiered
+        _, plan, hilo = route
+        return build_histogram_slots_tiered(X_binned_t, vals, slot,
+                                            num_slots, num_bins, plan,
+                                            hilo=hilo)
     return _build_histogram_slots_xla(X_binned_t, vals, slot, num_slots,
                                       num_bins, rows_per_chunk)
 

@@ -62,9 +62,16 @@ F_BLK = 32          # int8 sublane tile
 N_BLK = 2048        # rows per grid step
 
 
-def _compute_dims(num_bins: int):
+def _compute_dims(num_bins: int, wide_lo: int = 128):
     """B padded to a lane-friendly width; LO = one-hot compare width,
-    HB = number of 128-lane sub-blocks of the bin axis."""
+    HB = number of LO-wide sub-blocks of the bin axis.
+
+    `wide_lo` picks the hi/lo decomposition for bins wider than 128
+    (docs/PERF.md): 128 = the legacy two-pass split, 64 = the hi/lo
+    variant (2-bit hi part, 64-wide lo one-hot built once and masked per
+    hi value — 4 narrow matmuls instead of one 256-wide one-hot). Bin
+    codes decompose as bin = hi * LO + lo either way, so the two
+    variants produce bit-identical histograms."""
     if num_bins <= 32:
         B = 32
     elif num_bins <= 64:
@@ -74,6 +81,8 @@ def _compute_dims(num_bins: int):
     else:
         B = 256
     LO = min(B, 128)
+    if B > 128 and wide_lo in (32, 64):
+        LO = wide_lo
     HB = B // LO
     return B, LO, HB
 
@@ -116,15 +125,37 @@ def _accum_chunk(xx, W, out_ref, col0, *, C, K, LO, HB, quantized):
         out_ref[:, col0:col0 + Fc * LO] += part
     else:
         lo = xx & (LO - 1)
-        hi = xx >> 7
-        for hb in range(HB):
-            oh = ((lo[:, None, :] == iota3)
-                  & (hi == hb)[:, None, :]).reshape(Fc * LO, R) \
-                .astype(w_dtype)
-            part = jax.lax.dot_general(
-                W, oh, (((1,), (1,)), ((), ())),
-                preferred_element_type=acc)
-            out_ref[hb * C * K:(hb + 1) * C * K, col0:col0 + Fc * LO] += part
+        hi = xx >> (LO.bit_length() - 1)
+        if quantized:
+            # v5e Mosaic has no int8 vector select — build each pass's
+            # one-hot directly from the bool conjunction and narrow once
+            for hb in range(HB):
+                oh = ((lo[:, None, :] == iota3)
+                      & (hi == hb)[:, None, :]).reshape(Fc * LO, R) \
+                    .astype(w_dtype)
+                part = jax.lax.dot_general(
+                    W, oh, (((1,), (1,)), ((), ())),
+                    preferred_element_type=acc)
+                out_ref[hb * C * K:(hb + 1) * C * K,
+                        col0:col0 + Fc * LO] += part
+        else:
+            # hi/lo split: the LO-wide one-hot is compared AND converted
+            # ONCE; each hi pass only masks it with a 0/1 bf16 broadcast
+            # multiply. At LO=64/HB=4 that cuts the per-(feature, row)
+            # VPU volume roughly in half vs compare+convert per pass —
+            # the 255-bin one-hot build is VPU-bound, the MXU MAC count
+            # (HB*LO = B) is identical for every decomposition. The mask
+            # is exactly 0.0/1.0 so every product (and therefore the f32
+            # accumulation) is bit-identical to the fused compare.
+            oh_lo = (lo[:, None, :] == iota3).astype(w_dtype)  # [Fc,LO,R]
+            for hb in range(HB):
+                oh = (oh_lo * (hi == hb)[:, None, :].astype(w_dtype)) \
+                    .reshape(Fc * LO, R)
+                part = jax.lax.dot_general(
+                    W, oh, (((1,), (1,)), ((), ())),
+                    preferred_element_type=acc)
+                out_ref[hb * C * K:(hb + 1) * C * K,
+                        col0:col0 + Fc * LO] += part
 
 
 def _make_W(v, oh_slot, C, K, quantized):
@@ -191,7 +222,8 @@ def _unflatten_hist(out, K, C, F, Fp, LO, HB, num_bins):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("num_slots", "num_bins", "interpret"))
+                   static_argnames=("num_slots", "num_bins", "interpret",
+                                    "wide_lo"))
 def build_histogram_slots_pallas(
     X_binned_t: jnp.ndarray,   # [F, N] int8/uint8 (feature-major)
     vals: jnp.ndarray,         # [C, N] f32 (bag-masked) or int8 (quantized)
@@ -199,14 +231,16 @@ def build_histogram_slots_pallas(
     num_slots: int,
     num_bins: int,
     interpret: bool = False,
+    wide_lo: int = 128,
 ) -> jnp.ndarray:
     """Wave histogram on TPU: returns [K, C, F, num_bins] float32, or
-    int32 when `vals` is int8 (quantized-gradient training)."""
+    int32 when `vals` is int8 (quantized-gradient training). `wide_lo`
+    selects the wide-bin (>128) hi/lo decomposition (_compute_dims)."""
     F, N = X_binned_t.shape
     C = vals.shape[0]
     K = num_slots
     quantized = vals.dtype == jnp.int8
-    B, LO, HB = _compute_dims(num_bins)
+    B, LO, HB = _compute_dims(num_bins, wide_lo)
     rows = HB * C * K
     Fc_n = _feat_chunk(F, LO, rows)
     if F <= 32 and rows * _round_up(F, Fc_n) * LO * 4 <= 3_400_000:
@@ -475,7 +509,8 @@ def _wave_kernel(x_ref, v_ref, lor_ref, tbl_ref, nl0_ref, newlor_ref,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("num_slots", "num_bins", "interpret"))
+                   static_argnames=("num_slots", "num_bins", "interpret",
+                                    "wide_lo"))
 def wave_pass_pallas(
     X_binned_t: jnp.ndarray,   # [F, N] int8/uint8 (feature-major, F <= 32)
     vals: jnp.ndarray,         # [C, N] f32 (bag-masked) or int8 (quantized)
@@ -484,18 +519,22 @@ def wave_pass_pallas(
     num_slots: int,
     num_bins: int,
     interpret: bool = False,
+    wide_lo: int = 128,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Fused wave pass: returns (new_leaf_of_row [N] i32,
     hist [K, C, F, num_bins]). X/vals may be pre-padded (F to 32, rows to
     a block multiple) by the caller so the pad/convert cost is paid once
     per tree instead of once per wave; `leaf_of_row` keeps the true row
-    count and the outputs are sliced to it."""
+    count and the outputs are sliced to it. `wide_lo` selects the
+    wide-bin (>128) hi/lo decomposition (_compute_dims); the VMEM
+    footprint of the output block is identical for either choice
+    (HB*LO = B), so the caller's K cap is unaffected."""
     F, NX = X_binned_t.shape
     C = vals.shape[0]
     N = leaf_of_row.shape[0]
     K = num_slots
     quantized = vals.dtype == jnp.int8
-    B, LO, HB = _compute_dims(num_bins)
+    B, LO, HB = _compute_dims(num_bins, wide_lo)
     assert F <= 32, "wave megakernel requires F <= 32 storage columns"
     Fp = 32
     rows = HB * C * K
@@ -698,12 +737,14 @@ def wave_relabel_pallas(
     return newlor[0, :N]
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("num_bins", "interpret", "wide_lo"))
 def build_histogram_pallas(
     X_binned_t: jnp.ndarray,   # [F, N] int8/uint8 (feature-major)
     vals: jnp.ndarray,         # [C, N] f32 (already masked for leaf/bag)
     num_bins: int,
     interpret: bool = False,
+    wide_lo: int = 128,
 ) -> jnp.ndarray:
     """Single-set histogram on TPU: returns [C, F, num_bins] float32.
 
@@ -711,5 +752,5 @@ def build_histogram_pallas(
     N = X_binned_t.shape[1]
     slot = jnp.zeros((N,), jnp.int32)
     out = build_histogram_slots_pallas(X_binned_t, vals, slot, 1, num_bins,
-                                       interpret=interpret)
+                                       interpret=interpret, wide_lo=wide_lo)
     return out[0]

@@ -1,0 +1,166 @@
+"""Bin-width-tiered histogram construction (docs/PERF.md).
+
+`histogram_pallas.py` sizes its one-hot contraction by the WIDEST feature:
+once any feature needs more than 128 bins the whole dataset pays the
+B=256 cost — 256-wide one-hot compares per (feature, row) and a VMEM
+budget that forces tiny feature chunks. After EFB bundling most columns
+are narrow, so that uniform sizing is the dominant waste on 255-bin
+configs (the reference instead sizes every histogram per feature via
+`train_data->FeatureGroupOffsets()`-style offset tables,
+feature_histogram.hpp).
+
+This module is the TPU equivalent of those ragged offsets:
+
+  * `BinnedDataset` stably reorders its inner features by lane-width
+    class (<=32, <=64, <=128, <=256 — `lane_width`), so same-width
+    features are contiguous in storage (`data/dataset.py:
+    _apply_tier_order`; the permutation is recorded on the dataset).
+  * `build_tier_plan` turns the per-column bin counts into a `TierPlan`:
+    contiguous same-width runs, plus a per-feature offset table into a
+    single FLAT histogram buffer where feature f owns columns
+    [offset[f], offset[f] + width[f]).
+  * `build_histogram_slots_tiered_flat` issues ONE
+    `build_histogram_slots_pallas` invocation per run, each with its own
+    B/LO/HB and `_feat_chunk` sizing, and concatenates the per-run
+    [K, C, F_c * B_c] reshapes into the flat [K, C, total] buffer.
+  * `ops/split.py:expand_feature_offset_hist` gathers the flat buffer
+    back to the uniform [K, C, F, B] grid (out-of-range bins fill 0,
+    the same `mode="fill"` trick as the EFB bundle expansion) so the
+    split search, parent-subtraction caches and sharding layouts are
+    untouched.
+
+Unsorted inputs are tolerated — each maximal same-width run becomes its
+own plan class, so correctness never depends on the dataset reorder;
+only the kernel-launch count does.
+
+Accumulation-order note (the bit-identity contract the interpret-mode
+tests pin): a feature's histogram element is a sum over exactly the
+same rows walked in the same N_BLK row-block order whatever B the
+kernel is compiled for, so the tiered path reproduces the legacy
+mega-kernel's f32 sums bit-for-bit, per feature.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .histogram_pallas import build_histogram_slots_pallas
+
+LANE_WIDTHS = (32, 64, 128, 256)
+
+
+def lane_width(num_bin: int) -> int:
+    """Smallest lane-friendly kernel width holding `num_bin` bins —
+    mirrors `histogram_pallas._compute_dims` so a class kernel compiled
+    at this width puts every bin of its features in range."""
+    for w in LANE_WIDTHS:
+        if num_bin <= w:
+            return w
+    raise ValueError(f"num_bin {num_bin} exceeds 256 (8-bit storage)")
+
+
+class TierPlan(NamedTuple):
+    """Static per-dataset histogram layout (hashable — used as a jit
+    static argument and lru_cache key)."""
+    classes: tuple   # ((start, count, lane_B), ...) contiguous runs
+    offsets: tuple   # [F] per-feature start column in the flat buffer
+    widths: tuple    # [F] per-feature lane width (flat columns owned)
+    total: int       # flat buffer width = sum(count * lane_B)
+
+
+@functools.lru_cache(maxsize=256)
+def build_tier_plan(feature_num_bins: tuple) -> TierPlan:
+    """Group the per-storage-column bin counts into contiguous runs of
+    equal lane width and lay out the flat per-feature-offset buffer."""
+    widths = tuple(lane_width(int(nb)) for nb in feature_num_bins)
+    classes = []
+    start = 0
+    for f, w in enumerate(widths):
+        if f == 0 or w != widths[f - 1]:
+            if f > 0:
+                classes.append((start, f - start, widths[f - 1]))
+            start = f
+    if widths:
+        classes.append((start, len(widths) - start, widths[-1]))
+    offsets = []
+    base = 0
+    for (s, cnt, w) in classes:
+        offsets.extend(base + j * w for j in range(cnt))
+        base += cnt * w
+    return TierPlan(tuple(classes), tuple(offsets), widths, base)
+
+
+def class_wide_lo(lane_B: int, hilo: bool) -> int:
+    """Per-class hi/lo decomposition: the 256-wide class runs the
+    LO=64/HB=4 variant when `hilo` (4 narrow matmuls with a one-hot
+    that is compared and converted once — docs/PERF.md); narrower
+    classes are single-pass either way."""
+    return 64 if (hilo and lane_B > 128) else 128
+
+
+@functools.partial(jax.jit, static_argnames=("num_slots", "plan",
+                                             "interpret", "hilo"))
+def build_histogram_slots_tiered_flat(
+    X_binned_t: jnp.ndarray,   # [F, N] int8/uint8 (tier-ordered storage)
+    vals: jnp.ndarray,         # [C, N] f32 (bag-masked) or int8 (quantized)
+    slot: jnp.ndarray,         # [N] int32
+    num_slots: int,
+    plan: TierPlan,
+    interpret: bool = False,
+    hilo: bool = True,
+) -> jnp.ndarray:
+    """Flat per-feature-offset wave histogram: returns [K, C, total]
+    (f32, or int32 for quantized vals) — one kernel invocation per plan
+    class, each sized to ITS lane width, concatenated in plan order."""
+    assert len(plan.widths) == X_binned_t.shape[0]
+    parts = []
+    for (start, count, lane_B) in plan.classes:
+        h = build_histogram_slots_pallas(
+            X_binned_t[start:start + count], vals, slot, num_slots,
+            lane_B, interpret=interpret,
+            wide_lo=class_wide_lo(lane_B, hilo))
+        K, C = h.shape[0], h.shape[1]
+        parts.append(h.reshape(K, C, count * lane_B))
+    return jnp.concatenate(parts, axis=-1)
+
+
+def build_histogram_slots_tiered(
+    X_binned_t: jnp.ndarray,
+    vals: jnp.ndarray,
+    slot: jnp.ndarray,
+    num_slots: int,
+    num_bins: int,
+    plan: TierPlan,
+    interpret: bool = False,
+    hilo: bool = True,
+) -> jnp.ndarray:
+    """Tiered wave histogram expanded back to the uniform grid:
+    returns [K, C, F, num_bins] exactly like
+    `build_histogram_slots_pallas` (drop-in for the growers)."""
+    from .split import expand_feature_offset_hist
+    flat = build_histogram_slots_tiered_flat(
+        X_binned_t, vals, slot, num_slots, plan,
+        interpret=interpret, hilo=hilo)
+    return expand_feature_offset_hist(flat, plan.offsets, plan.widths,
+                                      num_bins)
+
+
+def build_histogram_tiered(
+    X_binned_t: jnp.ndarray,
+    vals: jnp.ndarray,
+    num_bins: int,
+    plan: TierPlan,
+    interpret: bool = False,
+    hilo: bool = True,
+) -> jnp.ndarray:
+    """Single-set tiered histogram: [C, F, num_bins] (K=1 wrapper)."""
+    slot = jnp.zeros((X_binned_t.shape[1],), jnp.int32)
+    out = build_histogram_slots_tiered(X_binned_t, vals, slot, 1,
+                                       num_bins, plan,
+                                       interpret=interpret, hilo=hilo)
+    return out[0]

@@ -38,6 +38,7 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..models.tree import MISSING_NAN, MISSING_NONE, MISSING_ZERO
 
@@ -45,6 +46,26 @@ from ..models.tree import MISSING_NAN, MISSING_NONE, MISSING_ZERO
 # XLA backend at import time, breaking multi-host bring-up
 # (jax.distributed.initialize must run before any backend touch)
 NEG_INF = float("-inf")
+
+
+def expand_feature_offset_hist(flat: jnp.ndarray, offsets: tuple,
+                               widths: tuple, num_bins: int) -> jnp.ndarray:
+    """Ragged per-feature-offset histogram -> uniform [..., F, num_bins]
+    grid for the split scans below.
+
+    `flat` is [..., total] where feature f owns the `widths[f]` columns
+    starting at `offsets[f]` (the reference's FeatureGroupOffsets layout;
+    see ops/histogram_tiered.py). Bins a feature does not own gather the
+    fill value 0 — they can hold no mass by construction, so the
+    cumulative forward/reverse scans and every gain formula are
+    unchanged. The same OOB-fill gather as the EFB bundle expansion
+    (models/gbdt.py bundle_expand)."""
+    offs = np.asarray(offsets, dtype=np.int32)[:, None]
+    wid = np.asarray(widths, dtype=np.int32)[:, None]
+    b = np.arange(num_bins, dtype=np.int32)[None, :]
+    idx = np.where(b < wid, offs + b, np.int32(-1))       # [F, num_bins]
+    return jnp.take(flat, jnp.asarray(idx), axis=-1,
+                    mode="fill", fill_value=0)
 
 
 class SplitHyperParams(NamedTuple):

@@ -11,12 +11,19 @@ dance for the TPU build's real degrees of freedom:
    tree per candidate on a row subsample of the REAL binned matrix with
    synthetic gradients from a fixed seed;
  * the histogram chunk layout (``rows_per_chunk``) by timing
-   ``build_histogram`` at candidate chunk sizes.
+   ``build_histogram`` at candidate chunk sizes;
+ * the histogram implementation (``legacy`` uniform kernel vs the
+   bin-width-tiered ``tiered``/``tiered_hilo`` paths of
+   ops/histogram_tiered.py — see docs/PERF.md) by timing
+   ``build_histogram`` per candidate, only when config left
+   ``histogram_impl=auto``.
 
 Decisions are cached in-process and on disk, keyed by
 (n_rows, n_features, max_bin, num_leaves, device kind) — the shape
-signature that determines kernel behavior, so a rerun of the same
-workload skips the probes entirely.
+signature that determines kernel behavior (bin width, row count and
+feature count pick the one-hot decomposition; docs/PERF.md documents
+the key layout), so a rerun of the same workload skips the probes
+entirely.
 
 Determinism: probe gradients come from a fixed ``seed`` and the timing
 clock is injectable (``timer``), so tests can force exact tie-breaks.
@@ -40,6 +47,11 @@ TIE_TOL = 0.02
 
 DEFAULT_PROBE_ROWS = 65536
 CHUNK_CANDIDATES = (4096, 8192, 32768)
+
+# histogram implementation candidates (ops/histogram.py _tier_route,
+# docs/PERF.md); tie preference matches the "auto" default so a tie
+# reproduces untuned behavior
+HIST_IMPL_CANDIDATES = ("tiered_hilo", "tiered", "legacy")
 
 # in-process decision cache: key -> decision dict
 _MEM_CACHE: Dict[str, Dict[str, Any]] = {}
@@ -205,6 +217,57 @@ def probe_rows_per_chunk(X_t, cfg, chunk_candidates: Sequence[int]
     return timings
 
 
+def probe_hist_impls(X_t, cfg, impl_candidates: Sequence[str]
+                     = HIST_IMPL_CANDIDATES,
+                     probe_rows: int = DEFAULT_PROBE_ROWS,
+                     seed: int = 0,
+                     timer: Callable[[], float] = time.perf_counter,
+                     ) -> Dict[str, float]:
+    """Time ``build_histogram`` per histogram implementation candidate
+    on the real binned subsample (docs/PERF.md): the legacy uniform
+    kernel vs the bin-width-tiered paths, including the hi/lo wide-bin
+    variant. Uses ``cfg.hist_tiers`` — callers gate on it being set."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops.histogram import build_histogram
+    from .profiler import device_barrier
+
+    n = int(X_t.shape[1])
+    m = max(min(int(probe_rows), n), 1)
+    Xs = jnp.asarray(jax.device_get(X_t[:, :m]))
+    rng = np.random.RandomState(seed)
+    vals = jnp.asarray(
+        rng.uniform(-0.5, 0.5, size=(2, m)).astype(np.float32))
+    B = int(cfg.num_bins_padded)
+    tiers = tuple(int(t) for t in cfg.hist_tiers)
+
+    timings: Dict[str, float] = {}
+    for impl in impl_candidates:
+
+        def run(X, v, _impl=impl):
+            return build_histogram(X, v, B,
+                                   rows_per_chunk=cfg.rows_per_chunk,
+                                   tiers=tiers, impl=_impl)
+
+        try:
+            jitted = jax.jit(run)
+            _block(jitted(Xs, vals))
+            best = float("inf")
+            for _ in range(2):
+                device_barrier()
+                t0 = timer()
+                _block(jitted(Xs, vals))
+                best = min(best, timer() - t0)
+            timings[impl] = best
+        except Exception as e:                    # noqa: BLE001
+            from ..utils.log import log_warning
+            log_warning(f"autotune: probe for histogram impl '{impl}' "
+                        f"failed ({type(e).__name__}); dropping candidate")
+    return timings
+
+
 def _pick_winner(timings: Dict[str, float],
                  preference: Sequence[str]) -> Optional[str]:
     """Fastest candidate; ties within TIE_TOL resolve by preference
@@ -263,12 +326,25 @@ def autotune_decision(X_t, meta, cfg, candidates: Sequence[str], *,
             if best is not None:
                 rows_per_chunk = int(best)
 
+    # histogram implementation: probed only when config left the choice
+    # open (histogram_impl=auto) and the dataset published its tier table
+    hist_impl: Optional[str] = None
+    hist_impl_timings: Dict[str, float] = {}
+    if getattr(cfg, "hist_impl", "auto") == "auto" \
+            and getattr(cfg, "hist_tiers", ()):
+        hist_impl_timings = probe_hist_impls(
+            X_t, cfg, probe_rows=probe_rows, seed=seed, timer=timer)
+        hist_impl = _pick_winner(hist_impl_timings, HIST_IMPL_CANDIDATES)
+
     decision: Dict[str, Any] = {
         "grower": winner,
         "rows_per_chunk": rows_per_chunk,
+        "hist_impl": hist_impl,
         "timings": {k: round(v, 6) for k, v in timings.items()},
         "chunk_timings": {str(k): round(v, 6)
                           for k, v in chunk_timings.items()},
+        "hist_impl_timings": {k: round(v, 6)
+                              for k, v in hist_impl_timings.items()},
         "key": key,
         "probe_rows": min(int(probe_rows), int(X_t.shape[1])),
     }

@@ -97,6 +97,189 @@ def test_masked_rows_contribute_nothing():
 
 
 # ---------------------------------------------------------------------------
+# Bin-width-tiered path (ops/histogram_tiered.py, docs/PERF.md): per-class
+# kernels into a flat per-feature-offset buffer, expanded back to the
+# uniform grid — parity with the XLA reference and BITWISE identity with
+# the legacy uniform kernel (the acceptance contract: each feature's sum
+# runs over the same rows in the same row-block order).
+# ---------------------------------------------------------------------------
+
+MIXED_NBINS = (15, 15, 63, 63, 63, 255, 255, 30, 120)
+
+
+def _tiered_inputs(nbins, N, rng):
+    X = np.stack([rng.randint(0, nb, N) for nb in nbins]).astype(np.uint8)
+    return X
+
+
+@pytest.mark.parametrize("nbins,B", [
+    (MIXED_NBINS, 256),               # mixed classes, unsorted tail
+    ((15, 9, 4), 16),                 # all-narrow, num_bins = 15-ish
+    ((63, 63, 40, 7), 64),            # two classes at 63-bin config
+    ((255,) * 5 + (63,) * 4, 256),    # wide + narrow at 255-bin config
+])
+@pytest.mark.parametrize("hilo", [True, False])
+def test_tiered_slots_matches_xla_and_legacy(nbins, B, hilo):
+    from lightgbm_tpu.ops.histogram_tiered import (build_tier_plan,
+                                                   build_histogram_slots_tiered)
+    rng = np.random.RandomState(sum(nbins))
+    N, C, K = 1500, 3, 4
+    X = _tiered_inputs(nbins, N, rng)
+    vals = _bf16_exact_vals(rng, C, N)
+    slot = rng.randint(-1, K + 1, size=N).astype(np.int32)
+    plan = build_tier_plan(nbins)
+    assert plan.total == sum(c * w for (_, c, w) in plan.classes)
+    ref = _build_histogram_slots_xla(jnp.asarray(X), jnp.asarray(vals),
+                                     jnp.asarray(slot), K, B)
+    got = build_histogram_slots_tiered(jnp.asarray(X), jnp.asarray(vals),
+                                       jnp.asarray(slot), K, B, plan,
+                                       interpret=True, hilo=hilo)
+    assert got.shape == (K, C, len(nbins), B)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+    leg = build_histogram_slots_pallas(jnp.asarray(X), jnp.asarray(vals),
+                                       jnp.asarray(slot), K, B,
+                                       interpret=True)
+    np.testing.assert_array_equal(np.asarray(leg), np.asarray(got))
+
+
+def test_tiered_quantized_int8_exact():
+    from lightgbm_tpu.ops.histogram_tiered import (build_tier_plan,
+                                                   build_histogram_slots_tiered)
+    rng = np.random.RandomState(21)
+    N, K, B = 1200, 4, 256
+    X = _tiered_inputs(MIXED_NBINS, N, rng)
+    vals = rng.randint(-127, 128, size=(2, N)).astype(np.int8)
+    slot = rng.randint(-1, K, size=N).astype(np.int32)
+    plan = build_tier_plan(MIXED_NBINS)
+    ref = _build_histogram_slots_xla(jnp.asarray(X), jnp.asarray(vals),
+                                     jnp.asarray(slot), K, B)
+    got = build_histogram_slots_tiered(jnp.asarray(X), jnp.asarray(vals),
+                                       jnp.asarray(slot), K, B, plan,
+                                       interpret=True, hilo=True)
+    assert got.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_tiered_flat_offsets_agree_with_reference():
+    """The ragged flat buffer itself: feature f's columns
+    [offset[f], offset[f]+width[f]) hold exactly its reference histogram
+    (the FeatureGroupOffsets layout contract)."""
+    from lightgbm_tpu.ops.histogram_tiered import (
+        build_tier_plan, build_histogram_slots_tiered_flat)
+    rng = np.random.RandomState(33)
+    N, K, B = 900, 3, 256
+    X = _tiered_inputs(MIXED_NBINS, N, rng)
+    vals = _bf16_exact_vals(rng, 2, N)
+    slot = rng.randint(-1, K, size=N).astype(np.int32)
+    plan = build_tier_plan(MIXED_NBINS)
+    flat = np.asarray(build_histogram_slots_tiered_flat(
+        jnp.asarray(X), jnp.asarray(vals), jnp.asarray(slot), K, plan,
+        interpret=True))
+    ref = np.asarray(_build_histogram_slots_xla(
+        jnp.asarray(X), jnp.asarray(vals), jnp.asarray(slot), K, B))
+    for f, nb in enumerate(MIXED_NBINS):
+        off, w = plan.offsets[f], plan.widths[f]
+        np.testing.assert_array_equal(flat[:, :, off:off + nb],
+                                      ref[:, :, f, :nb])
+        # columns beyond the feature's bins hold no mass
+        assert np.all(flat[:, :, off + nb:off + w] == 0.0)
+
+
+@pytest.mark.parametrize("num_bins", [15, 63, 255])
+def test_tiered_bin_configs(num_bins):
+    """num_bins sweep from the ISSUE checklist: single-width datasets at
+    each config, K=1 wrapper path."""
+    from lightgbm_tpu.ops.histogram_tiered import (build_tier_plan,
+                                                   build_histogram_tiered)
+    rng = np.random.RandomState(num_bins)
+    F, N = 6, 2000
+    nbins = (num_bins,) * F
+    X = _tiered_inputs(nbins, N, rng)
+    vals = _bf16_exact_vals(rng, 2, N)
+    plan = build_tier_plan(nbins)
+    assert len(plan.classes) == 1
+    ref = _build_histogram_xla(jnp.asarray(X), jnp.asarray(vals), num_bins)
+    got = build_histogram_tiered(jnp.asarray(X), jnp.asarray(vals),
+                                 num_bins, plan, interpret=True)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_hilo_wide_lo_bitwise_identical():
+    """The hi/lo wide-bin variant (wide_lo=64, 4 masked narrow matmuls)
+    must reproduce the legacy 128-wide two-pass split bit-for-bit — the
+    mask is exactly 0/1 in bf16, so every product and f32 sum agrees."""
+    rng = np.random.RandomState(44)
+    F, N, C, K, B = 12, 3000, 3, 4, 256
+    X = rng.randint(0, 255, size=(F, N)).astype(np.uint8)
+    vals = _bf16_exact_vals(rng, C, N)
+    slot = rng.randint(-1, K, size=N).astype(np.int32)
+    h64 = build_histogram_slots_pallas(jnp.asarray(X), jnp.asarray(vals),
+                                       jnp.asarray(slot), K, B,
+                                       interpret=True, wide_lo=64)
+    h128 = build_histogram_slots_pallas(jnp.asarray(X), jnp.asarray(vals),
+                                        jnp.asarray(slot), K, B,
+                                        interpret=True, wide_lo=128)
+    np.testing.assert_array_equal(np.asarray(h64), np.asarray(h128))
+    # quantized mode decomposes per-pass too
+    q = rng.randint(-64, 64, size=(2, N)).astype(np.int8)
+    q64 = build_histogram_slots_pallas(jnp.asarray(X), jnp.asarray(q),
+                                       jnp.asarray(slot), K, B,
+                                       interpret=True, wide_lo=64)
+    q128 = build_histogram_slots_pallas(jnp.asarray(X), jnp.asarray(q),
+                                        jnp.asarray(slot), K, B,
+                                        interpret=True, wide_lo=128)
+    np.testing.assert_array_equal(np.asarray(q64), np.asarray(q128))
+
+
+def test_tier_route_dispatch():
+    """_tier_route contract: legacy pin, feature-slice guard, single- vs
+    multi-class routing, and the narrower-than-num_bins single class."""
+    from lightgbm_tpu.ops.histogram import _tier_route
+    assert _tier_route(MIXED_NBINS, len(MIXED_NBINS), 256, "legacy") is None
+    assert _tier_route((), 9, 256, "auto") is None
+    assert _tier_route(MIXED_NBINS, 4, 256, "auto") is None   # sliced X
+    r = _tier_route(MIXED_NBINS, len(MIXED_NBINS), 256, "auto")
+    assert r[0] == "tiered"
+    single = _tier_route((255,) * 28, 28, 256, "auto")
+    assert single == ("legacy", 256, 64)
+    assert _tier_route((255,) * 28, 28, 256, "tiered") == ("legacy", 256,
+                                                           128)
+    # all-narrow dataset under a wide padded config runs the narrow kernel
+    assert _tier_route((40,) * 6, 6, 256, "auto") == ("legacy", 64, 128)
+
+
+def test_wave_pass_wide_lo_parity():
+    """wave_pass_pallas with the hi/lo variant: identical relabel and
+    bitwise-identical histograms vs the legacy decomposition."""
+    from lightgbm_tpu.ops.histogram_pallas import wave_pass_pallas
+    rng = np.random.RandomState(55)
+    F, N, B, K = 9, 2000, 256, 8
+    X = rng.randint(0, 255, size=(F, N)).astype(np.uint8)
+    vals = _bf16_exact_vals(rng, 2, N)
+    lor = rng.randint(0, 12, size=N).astype(np.int32)
+    tblr = [np.array([0, 3, 5, 7, -1, -1, -1, -1]),
+            rng.randint(0, F, size=K), rng.randint(0, B - 2, size=K),
+            rng.randint(0, 2, size=K), np.array([MT_NONE] * K),
+            rng.randint(0, B - 1, size=K), np.full(K, B - 1),
+            np.array([0, 12, 3, 13, 9, 11, -1, -1]),
+            rng.randint(0, F, size=K), rng.randint(0, B - 2, size=K),
+            rng.randint(0, 2, size=K), np.array([MT_NONE] * K),
+            rng.randint(0, B - 1, size=K), np.full(K, B - 1),
+            rng.randint(0, 2, size=K), np.full(K, 12)]
+    tbl_np = np.stack([np.asarray(t, np.int32) for t in tblr])
+    tbl16 = jnp.asarray(np.pad(tbl_np, ((0, 0), (0, 128 - K)),
+                               constant_values=-1))
+    lor64, hist64 = wave_pass_pallas(jnp.asarray(X), jnp.asarray(vals),
+                                     jnp.asarray(lor), tbl16, K, B,
+                                     interpret=True, wide_lo=64)
+    lor128, hist128 = wave_pass_pallas(jnp.asarray(X), jnp.asarray(vals),
+                                       jnp.asarray(lor), tbl16, K, B,
+                                       interpret=True, wide_lo=128)
+    np.testing.assert_array_equal(np.asarray(lor64), np.asarray(lor128))
+    np.testing.assert_array_equal(np.asarray(hist64), np.asarray(hist128))
+
+
+# ---------------------------------------------------------------------------
 # Wave megakernel (fused relabel + candidate membership + slot histogram)
 # and the leaf-value one-hot gather — interpret-mode parity with numpy
 # references implementing the portable-path semantics (grow_wave.py

@@ -1,0 +1,28 @@
+"""Tier-1 gate: numeric perf claims in README/docs must match the
+bench JSONs (scripts/check_stale_claims.py; rationale in docs/PERF.md)."""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(ROOT, "scripts", "check_stale_claims.py")
+
+
+def test_no_stale_perf_claims():
+    proc = subprocess.run([sys.executable, SCRIPT],
+                          capture_output=True, text=True, cwd=ROOT)
+    assert proc.returncode == 0, \
+        f"stale perf claims detected:\n{proc.stdout}{proc.stderr}"
+
+
+def test_checker_catches_a_wrong_multiplier():
+    # the gate is only worth having if it actually fires
+    sys.path.insert(0, os.path.join(ROOT, "scripts"))
+    try:
+        import check_stale_claims as csc
+    finally:
+        sys.path.pop(0)
+    values, ratios = csc.load_bench_values()
+    assert csc.verify(70.3, values, ratios)          # real README claim
+    assert not csc.verify(170.3, values, ratios)     # mutated claim
