@@ -122,13 +122,17 @@ def run(metric: str = "binary_train_throughput",
 def main() -> None:
     # BENCH_SERVING=1: run the serving bench instead (naive per-call
     # predict vs micro-batched serving; scripts/bench_serving.py)
-    if os.environ.get("BENCH_SERVING", "") not in ("", "0"):
-        import runpy
-        runpy.run_path(
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "scripts", "bench_serving.py"),
-            run_name="__main__")
-        return
+    # BENCH_ROWWISE=1: col-wise vs row-wise histogram layout bench
+    # (scripts/bench_rowwise.py, docs/PERF.md section 3)
+    for env, script in (("BENCH_SERVING", "bench_serving.py"),
+                        ("BENCH_ROWWISE", "bench_rowwise.py")):
+        if os.environ.get(env, "") not in ("", "0"):
+            import runpy
+            runpy.run_path(
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "scripts", script),
+                run_name="__main__")
+            return
     run()
 
 

@@ -82,30 +82,67 @@ def predict_throughput(rows=4_000_000, cols=28, trees=32):
                 device_speedup=round(dt_host / dt_dev, 1))
 
 
+SWEEP_SHAPES = ((28, 4_000_000, 8), (128, 1_000_000, 8),
+                (512, 250_000, 8), (968, 130_000, 8))
+
+
+def _device():
+    import jax
+    try:
+        return jax.default_backend()
+    except RuntimeError:
+        return "none"
+
+
 def main():
+    # Partial refresh: BENCH_SECTIONS="f_sweep_255bin,higgs_255bin"
+    # re-runs only those sections and MERGES into the existing
+    # BENCH_EXTRAS.json (other sections keep their recorded numbers);
+    # BENCH_SCALE=N divides the sweep row counts so a section can be
+    # refreshed on a smaller mesh — each record self-describes its
+    # rows, and refreshed sections carry the device they ran on.
+    import os
+    sections = [s for s in os.environ.get("BENCH_SECTIONS", "").split(",")
+                if s]
+    scale = max(int(os.environ.get("BENCH_SCALE", "1")), 1)
+
+    def want(name):
+        return not sections or name in sections
+
     out = {"description": "lightgbm_tpu sidecar benchmarks (one v5e chip)"}
-    out["predict_throughput"] = predict_throughput()
-    print(json.dumps(out["predict_throughput"]))
+    if sections:
+        try:
+            with open("BENCH_EXTRAS.json") as f:
+                out = json.load(f)
+        except OSError:
+            pass
+
+    if want("predict_throughput"):
+        out["predict_throughput"] = predict_throughput()
+        print(json.dumps(out["predict_throughput"]))
     # F-sweep at fixed rows x iters: the per-(row, feature) rate is the
     # cliff detector (a fixed-F fast path would crater beyond its limit)
-    sweep = []
-    for cols, rows, iters in ((28, 4_000_000, 8), (128, 1_000_000, 8),
-                              (512, 250_000, 8), (968, 130_000, 8)):
-        sweep.append(train_throughput(rows, cols, iters, 63))
-        print(json.dumps(sweep[-1]))
-    out["f_sweep_63bin"] = sweep
+    if want("f_sweep_63bin"):
+        sweep = []
+        for cols, rows, iters in SWEEP_SHAPES:
+            sweep.append(train_throughput(rows // scale, cols, iters, 63))
+            print(json.dumps(sweep[-1]))
+        out["f_sweep_63bin"] = sweep
     # the same sweep at full-width bins: the bin-width-tiered histogram
     # path (docs/PERF.md) must keep the 255-bin rate near the 63-bin one
-    sweep255 = []
-    for cols, rows, iters in ((28, 4_000_000, 8), (128, 1_000_000, 8),
-                              (512, 250_000, 8), (968, 130_000, 8)):
-        sweep255.append(train_throughput(rows, cols, iters, 255))
-        print(json.dumps(sweep255[-1]))
-    out["f_sweep_255bin"] = sweep255
+    if want("f_sweep_255bin"):
+        sweep255 = []
+        for cols, rows, iters in SWEEP_SHAPES:
+            sweep255.append(train_throughput(rows // scale, cols, iters,
+                                             255))
+            print(json.dumps(sweep255[-1]))
+        out["f_sweep_255bin"] = {"device": _device(), "shapes": sweep255}
     # full-width bins on the headline shape (the reference's published
     # Higgs config is a 255-bin run, docs/Experiments.rst)
-    out["higgs_255bin"] = train_throughput(4_000_000, 28, 8, 255)
-    print(json.dumps(out["higgs_255bin"]))
+    if want("higgs_255bin"):
+        out["higgs_255bin"] = train_throughput(4_000_000 // scale, 28, 8,
+                                               255)
+        print(json.dumps(out["higgs_255bin"]))
 
     with open("BENCH_EXTRAS.json", "w") as f:
         json.dump(out, f, indent=1)

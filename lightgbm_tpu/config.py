@@ -362,12 +362,19 @@ class Config:
     autotune_cache: str = ""           # decision cache path ("" = env
     #                                    LIGHTGBM_TPU_AUTOTUNE_CACHE or
     #                                    ~/.cache/lightgbm_tpu/autotune.json)
-    # bin-width-tiered histogram construction (docs/PERF.md):
-    #   auto        tier by width class; hi/lo wide-bin variant; autotune
-    #               may override per device/shape
+    # histogram construction layout (docs/PERF.md):
+    #   auto        col-wise, tiered by width class with the hi/lo
+    #               wide-bin variant; autotune (autotune=true) may
+    #               override per device/shape — including to rowwise
     #   legacy      uniform widest-feature kernel (pre-tiering behavior)
     #   tiered      per-class kernels, legacy 128-wide hi/lo split
     #   tiered_hilo per-class kernels + 64-wide hi/lo wide-bin variant
+    #   rowwise     row-wise multi-value kernel: one launch, per-feature
+    #               8-aligned widths into the flat offset buffer
+    #               (ops/histogram_rowwise.py, MultiValDenseBin analog)
+    # force_row_wise/force_col_wise (the reference's knobs) map onto this:
+    # force_row_wise pins rowwise, force_col_wise restricts autotune to
+    # the col-wise candidates; setting both is an error.
     histogram_impl: str = "auto"
 
     def __post_init__(self) -> None:
@@ -405,11 +412,25 @@ class Config:
                 "'basic', 'intermediate'; the reference's 'advanced' "
                 "method is not implemented — see docs/PARITY.md)")
         if self.histogram_impl not in ("auto", "legacy", "tiered",
-                                       "tiered_hilo"):
+                                       "tiered_hilo", "rowwise"):
             log_fatal(
                 f"Unknown histogram_impl '{self.histogram_impl}' "
-                "(supported: 'auto', 'legacy', 'tiered', 'tiered_hilo'; "
-                "see docs/PERF.md)")
+                "(supported: 'auto', 'legacy', 'tiered', 'tiered_hilo', "
+                "'rowwise'; see docs/PERF.md)")
+        # the reference rejects the contradictory pair the same way
+        # (config.cpp CheckParamConflict)
+        if self.force_col_wise and self.force_row_wise:
+            log_fatal("Cannot set both force_col_wise and force_row_wise "
+                      "to true (pick one histogram layout, or neither "
+                      "for the autotuned choice — docs/PERF.md)")
+        if self.force_row_wise and self.histogram_impl not in ("auto",
+                                                               "rowwise"):
+            log_fatal(
+                f"force_row_wise conflicts with histogram_impl="
+                f"'{self.histogram_impl}' (a col-wise layout); drop one")
+        if self.force_col_wise and self.histogram_impl == "rowwise":
+            log_fatal("force_col_wise conflicts with "
+                      "histogram_impl='rowwise'; drop one")
 
     def max_depth_effective(self) -> int:
         return self.max_depth if self.max_depth > 0 else 10**9

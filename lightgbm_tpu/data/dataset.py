@@ -128,6 +128,18 @@ class BinnedDataset:
         # ops/histogram_tiered.py can size one kernel per class. None =
         # reorder not applied (old binary caches before re-load).
         self.tier_perm: Optional[List[int]] = None
+        # row-wise multi-value pack (MultiValDenseBin analog,
+        # multi_val_dense_bin.hpp:21; docs/PERF.md): every used storage
+        # column's bins as ONE row-major dense [N, F_packed] uint8 array
+        # plus per-column offset/width tables into the flat per-feature-
+        # offset histogram buffer (ops/histogram_rowwise.py). Built
+        # lazily by `build_multival()`; derived deterministically from
+        # the storage matrix, so binary-cache round-trips rebuild it
+        # rather than store a second copy.
+        self.X_multival: Optional[np.ndarray] = None   # [N, F_packed]
+        self.multival_offsets: Optional[List[int]] = None
+        self.multival_widths: Optional[List[int]] = None
+        self.multival_total: int = 0
 
     # -- derived per-feature arrays consumed by device kernels
     @property
@@ -154,6 +166,44 @@ class BinnedDataset:
             infos.append("none" if inner < 0 else self.mappers[inner].feature_info())
         return infos
 
+    def storage_num_bins(self) -> List[int]:
+        """Per-STORAGE-COLUMN bin counts in storage order: EFB bundle
+        columns count their packed width (1 shared default bin + each
+        member's non-default bins), raw columns the mapper width — the
+        same tuple models/gbdt.py ships as GrowConfig.hist_tiers."""
+        if self.bundles is not None:
+            return [int(self.mappers[members[0]].num_bin)
+                    if len(members) == 1
+                    else 1 + sum(int(self.mappers[f].num_bin) - 1
+                                 for f in members)
+                    for members in self.bundles]
+        return [int(m.num_bin) for m in self.mappers]
+
+    def build_multival(self) -> Optional[np.ndarray]:
+        """Build (once) and return the row-wise multi-value pack: the
+        used storage columns — EFB bundle columns when bundling is
+        active, else the inner-feature columns — as one row-major
+        [N, F_packed] uint8 array, with `multival_offsets`/
+        `multival_widths` locating each column's bins in the flat
+        row-wise histogram buffer. Returns None when the storage is not
+        8-bit (the Pallas row-wise path only runs on uint8 bins).
+
+        The pack aliases the storage matrix when it is already C-order
+        (it always is for the in-memory constructors), so this costs
+        only the offset tables."""
+        if self.X_multival is not None:
+            return self.X_multival
+        X = self.X_bundled if self.bundles is not None else self.X_binned
+        if X is None or X.dtype != np.uint8:
+            return None
+        layout = _multival_layout(self.storage_num_bins())
+        if layout is None:
+            return None
+        self.multival_offsets, self.multival_widths, \
+            self.multival_total = layout
+        self.X_multival = np.ascontiguousarray(X)
+        return self.X_multival
+
     @property
     def label(self) -> Optional[np.ndarray]:
         return self.metadata.label if self.metadata else None
@@ -179,6 +229,30 @@ def _lane_width(num_bin: int) -> int:
         if num_bin <= w:
             return w
     return 512
+
+
+def _multival_layout(num_bins_seq):
+    """Flat row-wise histogram layout for the multi-value pack: numpy-
+    level twin of `ops/histogram_rowwise.build_rowwise_plan` (offsets/
+    widths/total only — duplicated so data loading never imports jax;
+    tests pin the two equal). Per-column widths are the bin count
+    rounded up to the 8-sublane tile, packed into 128-aligned column
+    chunks of <= 2048. Returns None when any column exceeds 256 bins
+    (uint16 storage has no Pallas path)."""
+    offsets, widths = [], []
+    col0 = used = 0
+    for nb in num_bins_seq:
+        if int(nb) > 256:
+            return None
+        w = max(-(-int(nb) // 8) * 8, 8)
+        if used and used + w > 2048:
+            col0 += -(-used // 128) * 128
+            used = 0
+        offsets.append(col0 + used)
+        widths.append(w)
+        used += w
+    total = col0 + (-(-used // 128) * 128 if used else 0)
+    return offsets, widths, total
 
 
 def _apply_tier_order(ds: BinnedDataset,

@@ -316,13 +316,16 @@ class GBDT:
         # widths; the dataset's tier reorder made same-width columns
         # contiguous
         if self._use_bundles:
-            hist_tiers = tuple(
-                int(ds.mappers[members[0]].num_bin) if len(members) == 1
-                else 1 + sum(int(ds.mappers[f].num_bin) - 1
-                             for f in members)
-                for members in ds.bundles)
+            hist_tiers = tuple(ds.storage_num_bins())
         else:
             hist_tiers = tuple(int(m.num_bin) for m in ds.mappers)
+        # the reference's layout knobs (config validation already rejected
+        # contradictory combinations): force_row_wise pins the row-wise
+        # multi-value kernel; force_col_wise is applied below by
+        # restricting the autotune candidate set to the col-wise impls
+        hist_impl_cfg = str(cfg.histogram_impl)
+        if cfg.force_row_wise and hist_impl_cfg == "auto":
+            hist_impl_cfg = "rowwise"
         self.grow_cfg = GrowConfig(
             num_leaves=cfg.num_leaves,
             max_depth=cfg.max_depth,
@@ -365,7 +368,7 @@ class GBDT:
             monotone_penalty=float(cfg.monotone_penalty),
             feature_parallel=self._feat_par,
             hist_tiers=hist_tiers,
-            hist_impl=str(cfg.histogram_impl),
+            hist_impl=hist_impl_cfg,
         )
 
         # grower selection: "wave" (default via auto) applies batched
@@ -570,7 +573,8 @@ class GBDT:
                     "constrained (forced tpu_grower, distributed/linear "
                     "mode, or a feature only the wave grower implements)")
             else:
-                from ..runtime.autotune import autotune_decision
+                from ..runtime.autotune import (COL_WISE_HIST_IMPLS,
+                                                autotune_decision)
                 with self._prof_span("autotune"):
                     decision = autotune_decision(
                         self.X_t, self.meta, self.grow_cfg,
@@ -580,7 +584,10 @@ class GBDT:
                         max_bin=max_bin,
                         num_leaves=cfg.num_leaves,
                         cache_path=cfg.autotune_cache,
-                        seed=int(cfg.seed or 0))
+                        seed=int(cfg.seed or 0),
+                        hist_impl_candidates=(COL_WISE_HIST_IMPLS
+                                              if cfg.force_col_wise
+                                              else None))
                 self.autotune_decision = decision
                 if decision.get("grower"):
                     if decision["grower"] != self.grower:
@@ -594,6 +601,10 @@ class GBDT:
                     self.grow_cfg = self.grow_cfg._replace(
                         rows_per_chunk=rc)
                 hist_impl = decision.get("hist_impl")
+                if hist_impl == "rowwise" and cfg.force_col_wise:
+                    # a decision cached by an unconstrained run; the
+                    # layout pin outranks it
+                    hist_impl = None
                 if hist_impl and hist_impl != self.grow_cfg.hist_impl:
                     log_info("autotune: probes picked histogram impl "
                              f"'{hist_impl}'")
@@ -614,15 +625,22 @@ class GBDT:
         Probes a row subsample of the resident binned matrix; skipped on
         meshes (X_t is sharded and the probe would only fence shard 0)."""
         from ..ops.histogram import build_histogram
+        from ..ops.histogram_rowwise import (build_rowwise_plan,
+                                             rowwise_eligible)
         from ..ops.histogram_tiered import build_tier_plan
         if max(self.grow_cfg.hist_tiers) > 256:
             return          # uint16 storage: no Pallas path, no tiers
-        plan = build_tier_plan(
-            tuple(int(t) for t in self.grow_cfg.hist_tiers))
+        tiers = tuple(int(t) for t in self.grow_cfg.hist_tiers)
+        plan = build_tier_plan(tiers)
         self.profiler.extras["hist_tiers"] = [
             {"start": s, "count": c, "lane_bins": w}
             for (s, c, w) in plan.classes]
         self.profiler.extras["hist_impl"] = self.grow_cfg.hist_impl
+        rplan = build_rowwise_plan(tiers)
+        self.profiler.extras["hist_rowwise"] = {
+            "flat_cols": rplan.total,
+            "col_wise_cols": sum(c * w for (_, c, w) in plan.classes),
+            "chunks": len(rplan.chunks)}
         if self.use_dist:
             return
         n_probe = int(min(self.N_pad, 65536))
@@ -631,6 +649,11 @@ class GBDT:
             with self._prof_span(f"hist_class_b{w}"):
                 build_histogram(self.X_t[s:s + c, :n_probe], vals,
                                 min(self.num_bins_padded, w))
+        if rowwise_eligible(rplan, 2, 1):
+            with self._prof_span("hist_rowwise"):
+                build_histogram(self.X_t[:, :n_probe], vals,
+                                self.num_bins_padded, tiers=tiers,
+                                impl="rowwise")
 
     def _prof_span(self, name: str):
         """The active profiler's span, or a no-op context."""

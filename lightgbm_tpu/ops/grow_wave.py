@@ -29,14 +29,17 @@ split mathematics, identical best-first (leaf-wise) order — into batched
      gather, no scatter, no order permutation).
 
 Order semantics by mode:
-  * wave_exact=True: same priority-queue order as the serial growers
-    (serial_tree_learner.cpp:222; argmax ties by index); only the schedule
-    of device work differs. Histogram entries are (grad, hess) pairs and
-    per-bin counts are cnt_factor-synthesized at search time
-    (synth_count_channel, matching the reference's
-    feature_histogram.hpp:529,844) — the same count semantics as every
-    other grower mode; see docs/PARITY.md for the known rounding
-    deviations. Cost: ~O(priority-chain) waves.
+  * wave_exact=True: one split applied per wave, chosen by the serial
+    growers' priority rule (best frontier gain, serial_tree_learner.cpp:222;
+    argmax ties by index). This is an ORDER guarantee, not a bit-identity
+    guarantee: histogram entries are (grad, hess) pairs only and per-bin
+    counts are cnt_factor-synthesized at search time (synth_count_channel,
+    matching the reference's feature_histogram.hpp:529,844), so
+    min_data_in_leaf decisions and equal-gain ties on bins within the
+    synthesized channel's rounding noise can resolve differently than the
+    serial growers' — trees may diverge on such marginal splits
+    (docs/PARITY.md "Count-channel synthesis" documents the tolerance).
+    Cost: ~O(priority-chain) waves.
   * wave_exact=False (default): each wave applies EVERY ready leaf whose
     gain >= wave_gain_slack * (best frontier gain), in gain order — a
     gain-prioritized batched frontier that approaches strict leaf-wise as
@@ -209,9 +212,13 @@ def grow_tree_wave(
     # fused wave megakernel availability (TPU, dense int8 storage, no
     # categorical, narrow enough to hold all features in one kernel block)
     from .histogram import _use_pallas
+    # hist_impl="rowwise" (config pin or autotune) takes the unfused path
+    # so its waves actually run the row-wise multi-value kernel — the
+    # megakernel's fused histogram is col-wise only
     use_mega = (_use_pallas(X_t, B) and not cfg.bundled
                 and not cfg.has_categorical and X_t.shape[0] <= 32
-                and not cfg.feature_parallel)
+                and not cfg.feature_parallel
+                and cfg.hist_impl != "rowwise")
     if use_mega:
         # the megakernel's [HB*C*K, 32*LO] f32 output block lives in VMEM
         # for the whole grid; bound K so it stays within scoped VMEM.

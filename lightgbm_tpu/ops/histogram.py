@@ -59,14 +59,17 @@ def _tier_route(tiers, F: int, num_bins: int, impl: str):
 
     `tiers` is the per-STORAGE-COLUMN bin count tuple in storage order
     (GrowConfig.hist_tiers); `impl` is one of "auto" / "legacy" /
-    "tiered" / "tiered_hilo" (config.histogram_impl, possibly
-    overridden by runtime/autotune.py).
+    "tiered" / "tiered_hilo" / "rowwise" (config.histogram_impl,
+    possibly overridden by runtime/autotune.py).
 
     Returns None (uniform legacy kernel, caller's num_bins), or
     ("legacy", eff_bins, wide_lo) — single width class: one kernel
     sized to the class lane width (zero-padded back up to num_bins),
     with the hi/lo wide-bin variant when eligible — or
-    ("tiered", plan, hilo) for the multi-class flat-offset path.
+    ("tiered", plan, hilo) for the multi-class flat-offset path, or
+    ("rowwise", rplan) for the row-wise multi-value path
+    (histogram_rowwise.py; the caller still checks `rowwise_eligible`
+    against its C*K output size and falls back to the col-wise route).
 
     The `len(tiers) != F` guard keeps callers that slice the feature
     axis (feature-parallel shards, compile-warm dummy calls) on the
@@ -74,6 +77,10 @@ def _tier_route(tiers, F: int, num_bins: int, impl: str):
     if impl == "legacy" or not tiers or len(tiers) != F \
             or max(tiers) > 256:
         return None
+    if impl == "rowwise":
+        from .histogram_rowwise import build_rowwise_plan
+        return ("rowwise",
+                build_rowwise_plan(tuple(int(t) for t in tiers)))
     from .histogram_tiered import build_tier_plan, class_wide_lo
     plan = build_tier_plan(tuple(int(t) for t in tiers))
     hilo = impl in ("auto", "tiered_hilo")
@@ -104,6 +111,15 @@ def build_histogram(
     if _use_pallas(X_binned_t, num_bins):
         from .histogram_pallas import build_histogram_pallas
         route = _tier_route(tiers, X_binned_t.shape[0], num_bins, impl)
+        if route is not None and route[0] == "rowwise":
+            from .histogram_rowwise import (build_histogram_rowwise,
+                                            rowwise_eligible)
+            if rowwise_eligible(route[1], vals.shape[0], 1):
+                return build_histogram_rowwise(X_binned_t, vals, num_bins,
+                                               route[1])
+            # flat output exceeds the VMEM residency budget: col-wise
+            route = _tier_route(tiers, X_binned_t.shape[0], num_bins,
+                                "auto")
         if route is None:
             return build_histogram_pallas(X_binned_t, vals, num_bins)
         if route[0] == "legacy":
@@ -140,6 +156,15 @@ def build_histogram_slots(
     if _use_pallas(X_binned_t, num_bins):
         from .histogram_pallas import build_histogram_slots_pallas
         route = _tier_route(tiers, X_binned_t.shape[0], num_bins, impl)
+        if route is not None and route[0] == "rowwise":
+            from .histogram_rowwise import (build_histogram_slots_rowwise,
+                                            rowwise_eligible)
+            if rowwise_eligible(route[1], vals.shape[0], num_slots):
+                return build_histogram_slots_rowwise(
+                    X_binned_t, vals, slot, num_slots, num_bins, route[1])
+            # wide wave: flat output exceeds the VMEM residency budget
+            route = _tier_route(tiers, X_binned_t.shape[0], num_bins,
+                                "auto")
         if route is None:
             return build_histogram_slots_pallas(X_binned_t, vals, slot,
                                                 num_slots, num_bins)

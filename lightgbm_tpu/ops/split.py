@@ -47,6 +47,17 @@ from ..models.tree import MISSING_NAN, MISSING_NONE, MISSING_ZERO
 # (jax.distributed.initialize must run before any backend touch)
 NEG_INF = float("-inf")
 
+# min_data_in_leaf comparison slack for the hessian-synthesized count
+# channel (synth_count_channel): 0.5 is exactly the round-to-nearest
+# admit region the previous `round(c) >= m` compare defined, restated
+# on the unrounded channel so the tolerance is explicit (and the m-0.5
+# tie resolves deterministically to "admit" instead of round-half-even).
+# It must NOT be widened further: bf16 accumulation noise near one
+# count spacing (0.25 below ~2^7) would then admit leaves whose true
+# count is m-1 — a real min_data violation, not a rounding artifact
+# (docs/PARITY.md "synthesized-count tolerance").
+SYNTH_COUNT_SLACK = 0.5
+
 
 def expand_feature_offset_hist(flat: jnp.ndarray, offsets: tuple,
                                widths: tuple, num_bins: int) -> jnp.ndarray:
@@ -195,6 +206,14 @@ def _numeric_gain_map(hist, parent_sum_g, parent_sum_h, parent_count,
 
     lg, lh, lc = left[0], left[1], jnp.round(left[2])        # [2, F, B]
     rg, rh, rc = right[0], right[1], jnp.round(right[2])
+    # min_data_in_leaf screening runs on the UNROUNDED synthesized
+    # channel with SYNTH_COUNT_SLACK: >= m - 0.5 is exactly the
+    # round-to-nearest admit region the rounded compare had, so a leaf
+    # whose exact count meets the threshold is not rejected for
+    # synthesizing a hair under it, while one short by a full row stays
+    # rejected (docs/PARITY.md "synthesized-count tolerance")
+    lc_ok = left[2] >= hp.min_data_in_leaf - SYNTH_COUNT_SLACK
+    rc_ok = right[2] >= hp.min_data_in_leaf - SYNTH_COUNT_SLACK
 
     # threshold validity (scan ranges, feature_histogram.hpp:860-944):
     # t in [0, num_bin-2]; for the reverse scan of a NaN-missing feature the
@@ -213,7 +232,7 @@ def _numeric_gain_map(hist, parent_sum_g, parent_sum_h, parent_count,
                      axis=0)
 
     ok = (t_ok
-          & (lc >= hp.min_data_in_leaf) & (rc >= hp.min_data_in_leaf)
+          & lc_ok & rc_ok
           & (lh >= hp.min_sum_hessian_in_leaf)
           & (rh >= hp.min_sum_hessian_in_leaf))
     if feature_mask is not None:

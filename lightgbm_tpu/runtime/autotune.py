@@ -50,8 +50,12 @@ CHUNK_CANDIDATES = (4096, 8192, 32768)
 
 # histogram implementation candidates (ops/histogram.py _tier_route,
 # docs/PERF.md); tie preference matches the "auto" default so a tie
-# reproduces untuned behavior
-HIST_IMPL_CANDIDATES = ("tiered_hilo", "tiered", "legacy")
+# reproduces untuned behavior — the row-wise layout probes last and must
+# win outright (the TrainingShareStates col-vs-row timing dance,
+# train_share_states.cpp InitTrain)
+HIST_IMPL_CANDIDATES = ("tiered_hilo", "tiered", "legacy", "rowwise")
+# force_col_wise restricts the probe to these (models/gbdt.py)
+COL_WISE_HIST_IMPLS = ("tiered_hilo", "tiered", "legacy")
 
 # in-process decision cache: key -> decision dict
 _MEM_CACHE: Dict[str, Dict[str, Any]] = {}
@@ -224,9 +228,13 @@ def probe_hist_impls(X_t, cfg, impl_candidates: Sequence[str]
                      timer: Callable[[], float] = time.perf_counter,
                      ) -> Dict[str, float]:
     """Time ``build_histogram`` per histogram implementation candidate
-    on the real binned subsample (docs/PERF.md): the legacy uniform
-    kernel vs the bin-width-tiered paths, including the hi/lo wide-bin
-    variant. Uses ``cfg.hist_tiers`` — callers gate on it being set."""
+    on the real binned subsample (docs/PERF.md): the col-wise kernels
+    (legacy uniform, bin-width-tiered, hi/lo wide-bin variant) vs the
+    row-wise multi-value layout — the ``TrainingShareStates::InitTrain``
+    col-vs-row timing probe, run on device instead of estimated from
+    sparsity. Uses ``cfg.hist_tiers`` — callers gate on it being set;
+    ``impl_candidates`` narrows the field (``force_col_wise`` passes
+    ``COL_WISE_HIST_IMPLS``)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -291,20 +299,27 @@ def autotune_decision(X_t, meta, cfg, candidates: Sequence[str], *,
                       num_leaves: int, cache_path: str = "",
                       probe_rows: int = DEFAULT_PROBE_ROWS, seed: int = 0,
                       timer: Callable[[], float] = time.perf_counter,
-                      tune_chunks: bool = True) -> Dict[str, Any]:
+                      tune_chunks: bool = True,
+                      hist_impl_candidates: Optional[Sequence[str]] = None,
+                      ) -> Dict[str, Any]:
     """Full decision: cached if seen, otherwise probe and cache.
 
     Returns ``{"grower", "rows_per_chunk", "timings", "chunk_timings",
     "key", "probe_rows", "cached"}``. ``grower`` is None when every
     probe failed (caller keeps its ladder choice).
+    ``hist_impl_candidates`` restricts the histogram-layout probe (e.g.
+    COL_WISE_HIST_IMPLS under force_col_wise); None = all candidates.
     """
+    impl_cands = tuple(hist_impl_candidates or HIST_IMPL_CANDIDATES)
     key = make_key(n_rows, n_features, max_bin, num_leaves)
-    if key in _MEM_CACHE:
+    if key in _MEM_CACHE \
+            and _MEM_CACHE[key].get("hist_impl") in (None, *impl_cands):
         return dict(_MEM_CACHE[key], cached="memory")
     path = cache_path or default_cache_path()
     disk = load_disk_cache(path)
     hit = disk.get(key)
-    if isinstance(hit, dict) and hit.get("grower") in (None, *candidates):
+    if isinstance(hit, dict) and hit.get("grower") in (None, *candidates) \
+            and hit.get("hist_impl") in (None, *impl_cands):
         _MEM_CACHE[key] = hit
         return dict(hit, cached="disk")
 
@@ -333,7 +348,8 @@ def autotune_decision(X_t, meta, cfg, candidates: Sequence[str], *,
     if getattr(cfg, "hist_impl", "auto") == "auto" \
             and getattr(cfg, "hist_tiers", ()):
         hist_impl_timings = probe_hist_impls(
-            X_t, cfg, probe_rows=probe_rows, seed=seed, timer=timer)
+            X_t, cfg, impl_candidates=impl_cands,
+            probe_rows=probe_rows, seed=seed, timer=timer)
         hist_impl = _pick_winner(hist_impl_timings, HIST_IMPL_CANDIDATES)
 
     decision: Dict[str, Any] = {

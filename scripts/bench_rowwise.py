@@ -1,0 +1,127 @@
+"""Histogram layout bench: col-wise vs row-wise multi-value
+(docs/PERF.md section 3; the reference's `TrainingShareStates` col/row
+decision, measured instead of estimated).
+
+Three shapes, each a [F, N] binned matrix + a K-slot wave:
+
+  * ``dense_narrow_mixed`` — a few wide features dragging a mostly
+    narrow/odd-width table up to a wide uniform bin axis: the row-wise
+    layout's win case (each feature at its exact 8-aligned width).
+  * ``dense_wide`` — uniform 255-bin features (Higgs-like): col-wise
+    territory.
+  * ``sparse_onehot`` — many tiny post-EFB bundle columns, uniform
+    narrow bin axis.
+
+On a TPU backend both arms run the real Pallas kernels through
+``ops.histogram.build_histogram_slots`` (col-wise = tiered hi/lo,
+row-wise = the multi-value kernel). Elsewhere the arms are the exact
+XLA lowerings the production CPU path dispatches to — the uniform
+``_build_histogram_slots_xla`` at the padded bin width vs the flat
+``_build_histogram_slots_rowwise_xla`` — so the MAC economy of the
+layout (flat exact widths vs uniform lane width) is measured honestly
+on any backend; the ``device`` field records which.
+
+Emits ONE JSON line (also runnable via ``BENCH_ROWWISE=1 python
+bench.py``); redirect to BENCH_ROWWISE.json to refresh the committed
+artifact checked by scripts/check_stale_claims.py.
+
+Env knobs: ROWWISE_ROWS (default 300000), ROWWISE_SLOTS (8),
+ROWWISE_REPS (3).
+"""
+
+import functools
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _shapes(rows):
+    return {
+        "dense_narrow_mixed":
+            (4 * (256,) + 12 * (33,) + 24 * (12,) + 24 * (8,), rows),
+        "dense_wide": (28 * (256,), rows),
+        "sparse_onehot": (96 * (8,), rows),
+    }
+
+
+def main() -> None:
+    rows = int(os.environ.get("ROWWISE_ROWS", "300000"))
+    K = int(os.environ.get("ROWWISE_SLOTS", "8"))
+    reps = int(os.environ.get("ROWWISE_REPS", "3"))
+
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.histogram import (_build_histogram_slots_xla,
+                                            build_histogram_slots)
+    from lightgbm_tpu.ops.histogram_rowwise import (
+        _build_histogram_slots_rowwise_xla, build_rowwise_plan,
+        rowwise_eligible)
+    from lightgbm_tpu.utils import round_up
+
+    try:
+        backend = jax.default_backend()
+    except RuntimeError:
+        backend = "none"
+    on_tpu = backend == "tpu"
+
+    results = {}
+    rng = np.random.RandomState(42)
+    for name, (tiers, n) in _shapes(rows).items():
+        F = len(tiers)
+        B = max(round_up(max(tiers), 8), 8)
+        plan = build_rowwise_plan(tiers)
+        X = jnp.asarray(np.stack(
+            [rng.randint(0, nb, n) for nb in tiers]).astype(np.uint8))
+        vals = jnp.asarray(
+            rng.uniform(-0.5, 0.5, size=(2, n)).astype(np.float32))
+        slot = jnp.asarray(rng.randint(0, K, size=n).astype(np.int32))
+
+        if on_tpu:
+            def col(X, v, s, _t=tiers, _B=B):
+                return build_histogram_slots(X, v, s, K, _B, tiers=_t,
+                                             impl="tiered_hilo")
+
+            def row(X, v, s, _t=tiers, _B=B):
+                return build_histogram_slots(X, v, s, K, _B, tiers=_t,
+                                             impl="rowwise")
+        else:
+            def col(X, v, s, _B=B):
+                return _build_histogram_slots_xla(X, v, s, K, _B)
+
+            def row(X, v, s, _plan=plan):
+                return _build_histogram_slots_rowwise_xla(X, v, s, K,
+                                                          _plan)
+
+        arms = {"colwise": col}
+        if rowwise_eligible(plan, 2, K):
+            arms["rowwise"] = row
+        entry = {"features": F, "rows": n, "num_bins": B,
+                 "flat_cols": plan.total, "colwise_cols": F * B}
+        for arm, fn in arms.items():
+            jitted = jax.jit(fn)
+            jax.block_until_ready(jitted(X, vals, slot))   # compile
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(jitted(X, vals, slot))
+                best = min(best, time.perf_counter() - t0)
+            entry[f"{arm}_rows_per_sec"] = round(n / best, 1)
+        if "rowwise_rows_per_sec" in entry:
+            entry["rowwise_speedup"] = round(
+                entry["rowwise_rows_per_sec"]
+                / entry["colwise_rows_per_sec"], 4)
+        results[name] = entry
+
+    print(json.dumps({
+        "metric": "hist_layout_colwise_vs_rowwise",
+        "device": backend,
+        "num_slots": K,
+        "shapes": results,
+    }))
+
+
+if __name__ == "__main__":
+    main()
