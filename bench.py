@@ -124,8 +124,12 @@ def main() -> None:
     # predict vs micro-batched serving; scripts/bench_serving.py)
     # BENCH_ROWWISE=1: col-wise vs row-wise histogram layout bench
     # (scripts/bench_rowwise.py, docs/PERF.md section 3)
+    # BENCH_COMM=1: histogram-exchange collective bench, allreduce vs
+    # reduce_scatter vs packed (scripts/bench_comm.py, docs/PERF.md
+    # section 5); writes BENCH_COMM.json
     for env, script in (("BENCH_SERVING", "bench_serving.py"),
-                        ("BENCH_ROWWISE", "bench_rowwise.py")):
+                        ("BENCH_ROWWISE", "bench_rowwise.py"),
+                        ("BENCH_COMM", "bench_comm.py")):
         if os.environ.get(env, "") not in ("", "0"):
             import runpy
             runpy.run_path(

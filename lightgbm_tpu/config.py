@@ -88,6 +88,7 @@ _alias("bin_construct_sample_cnt", "bin_construct_sample_cnt",
        "subsample_for_bin")
 _alias("data_random_seed", "data_seed")
 _alias("histogram_impl", "hist_impl", "tpu_histogram_impl")
+_alias("parallel_hist_mode", "hist_comm_mode", "parallel_histogram_mode")
 _alias("is_enable_sparse", "is_sparse", "enable_sparse", "sparse")
 _alias("enable_bundle", "is_enable_bundle", "bundle")
 _alias("use_missing", "use_missing")
@@ -377,6 +378,20 @@ class Config:
     # the col-wise candidates; setting both is an error.
     histogram_impl: str = "auto"
 
+    # -- data-parallel histogram exchange (docs/PERF.md §Communication;
+    # reference: data_parallel_tree_learner.cpp ReduceScatter +
+    # SyncUpGlobalBestSplit):
+    #   auto            each grower's default exchange; the runtime
+    #                   autotuner may probe and pin a mode per mesh/shape
+    #   allreduce       full-histogram psum to every rank (every rank
+    #                   searches every feature — debugging escape hatch)
+    #   reduce_scatter  psum_scatter feature-slice ownership + sliced
+    #                   split search + broadcast-free pmax winner sync;
+    #                   int32-packed-int16 payloads under quantized grads
+    # Only meaningful for tree_learner=data; any explicit (non-auto)
+    # value with another learner is a config contradiction.
+    parallel_hist_mode: str = "auto"
+
     def __post_init__(self) -> None:
         self._validate()
 
@@ -431,6 +446,24 @@ class Config:
         if self.force_col_wise and self.histogram_impl == "rowwise":
             log_fatal("force_col_wise conflicts with "
                       "histogram_impl='rowwise'; drop one")
+        if self.parallel_hist_mode not in ("auto", "allreduce",
+                                           "reduce_scatter"):
+            log_fatal(
+                f"Unknown parallel_hist_mode '{self.parallel_hist_mode}' "
+                "(supported: 'auto', 'allreduce', 'reduce_scatter'; see "
+                "docs/PERF.md)")
+        # histogram exchange modes only exist for the data-parallel
+        # learner: feature/voting learners never move full histograms
+        # (their collectives are record merges / voted columns), and the
+        # serial learner has no mesh axis at all — an explicit mode there
+        # is a contradiction, not a no-op (CheckParamConflict style)
+        if self.parallel_hist_mode != "auto" \
+                and self.tree_learner not in ("data", "data_parallel"):
+            log_fatal(
+                f"parallel_hist_mode='{self.parallel_hist_mode}' requires "
+                f"tree_learner=data (got tree_learner="
+                f"'{self.tree_learner}'); the histogram exchange only "
+                "exists for the data-parallel learner — docs/PERF.md")
 
     def max_depth_effective(self) -> int:
         return self.max_depth if self.max_depth > 0 else 10**9

@@ -202,7 +202,7 @@ class GBDT:
         #    data-parallel path too: with histograms psum-reduced the voting
         #    compression and per-rank feature ownership are pure comm
         #    optimizations, not semantic ones.
-        from ..parallel import make_data_mesh, pad_rows_to
+        from ..parallel import lane_multiple, make_data_mesh, pad_rows_to
         n_dev = jax.device_count()
         self.use_dist = (cfg.tree_learner in ("data", "feature", "voting")
                          and n_dev > 1)
@@ -241,7 +241,8 @@ class GBDT:
                 # every process pads its host arrays to the same local
                 # size so the global sharded array is uniform
                 per = max(int(counts.max()), 1)
-                self._host_pad = pad_rows_to(per, self.n_shards // nproc)
+                self._host_pad = pad_rows_to(per, self.n_shards // nproc,
+                                             multiple=lane_multiple())
                 self.N_pad = self._host_pad * nproc
                 log_info(
                     f"Pre-partitioned data-parallel training: rank "
@@ -250,7 +251,8 @@ class GBDT:
                     f"devices, global rows padded to {self.N_pad}")
                 self._dist_guards(cfg)
             else:
-                self.N_pad = pad_rows_to(N_real, self.n_shards)
+                self.N_pad = pad_rows_to(N_real, self.n_shards,
+                                         multiple=lane_multiple())
                 self._host_pad = self.N_pad
                 log_info(f"Data-parallel training over {self.n_shards} "
                          f"devices ({N_real} rows padded to "
@@ -369,6 +371,7 @@ class GBDT:
             feature_parallel=self._feat_par,
             hist_tiers=hist_tiers,
             hist_impl=hist_impl_cfg,
+            parallel_hist_mode=str(cfg.parallel_hist_mode),
         )
 
         # grower selection: "wave" (default via auto) applies batched
@@ -572,6 +575,33 @@ class GBDT:
                     "autotune=true ignored: the grower choice is "
                     "constrained (forced tpu_grower, distributed/linear "
                     "mode, or a feature only the wave grower implements)")
+                # the histogram-EXCHANGE mode is still a free variable on
+                # a data-parallel mesh: probe allreduce vs reduce_scatter
+                # at the real payload shape (both produce bit-identical
+                # trees, so this only tunes the wire profile)
+                if (self.use_dist and not self._feat_par
+                        and cfg.tree_learner in ("data", "data_parallel")
+                        and cfg.parallel_hist_mode == "auto"):
+                    from ..runtime.autotune import autotune_comm_decision
+                    with self._prof_span("autotune"):
+                        comm = autotune_comm_decision(
+                            self.mesh,
+                            n_rows=self.num_data,
+                            n_features=int(self.X_t.shape[0]),
+                            max_bin=max_bin,
+                            num_leaves=cfg.num_leaves,
+                            num_bins_padded=self.num_bins_padded,
+                            cache_path=cfg.autotune_cache,
+                            seed=int(cfg.seed or 0))
+                    self.autotune_decision = comm
+                    mode = comm.get("parallel_hist_mode")
+                    if mode:
+                        log_info("autotune: comm probe picked "
+                                 f"parallel_hist_mode='{mode}'")
+                        self.grow_cfg = self.grow_cfg._replace(
+                            parallel_hist_mode=str(mode))
+                    if self.profiler is not None:
+                        self.profiler.extras["autotune_comm"] = comm
             else:
                 from ..runtime.autotune import (COL_WISE_HIST_IMPLS,
                                                 autotune_decision)
@@ -616,6 +646,14 @@ class GBDT:
         if self.profiler is not None and self.grow_cfg.hist_tiers:
             self._profile_hist_tiers()
 
+        # analytic histogram-exchange wire profile (docs/PERF.md
+        # §Communication): fixed for the whole run once the grower and
+        # parallel_hist_mode are settled, attached to every iteration
+        # record by train_one_iter
+        self._comm_profile = self._comm_iter_profile()
+        if self.profiler is not None and self._comm_profile:
+            self.profiler.extras["comm"] = dict(self._comm_profile)
+
         self._build_jit_fns()
 
     def _profile_hist_tiers(self) -> None:
@@ -654,6 +692,52 @@ class GBDT:
                 build_histogram(self.X_t[:, :n_probe], vals,
                                 self.num_bins_padded, tiers=tiers,
                                 impl="rowwise")
+
+    def _comm_iter_profile(self) -> Optional[Dict[str, Any]]:
+        """Analytic on-wire byte count of the per-tree histogram exchange
+        (docs/PERF.md §Communication payload math). The grower is one
+        fused jit, so the host cannot fence-time individual collectives;
+        what it CAN state exactly is the payload shape, the exchange
+        count bound (one [2,F,B] root pass plus one child exchange per
+        split) and the ring-algorithm wire factor — 2(k-1)/k for a full
+        psum, (k-1)/k for psum_scatter. Packed quantized lanes halve the
+        channel count (parallel/packed.py). Returns None when training
+        is not data-parallel (nothing crosses the mesh axis per split)."""
+        if not self.use_dist or self._feat_par:
+            return None
+        from ..utils import round_up
+        gcfg = self.grow_cfg
+        k = int(self.n_shards)
+        F = int(self.X_t.shape[0])
+        B = int(gcfg.num_bins_padded)
+        L = int(gcfg.num_leaves)
+        wave = self.grower in ("wave", "wave_exact")
+        mode = str(gcfg.parallel_hist_mode)
+        if mode == "auto":
+            # each grower's default exchange (ops/grow.py, grow_wave.py)
+            mode = "reduce_scatter" if wave else "allreduce"
+        Fx = round_up(F, k) if mode == "reduce_scatter" else F
+        packed = False
+        if wave:
+            channels = 2          # (grad, hess) lanes, f32 or int32
+            if gcfg.use_quantized_grad:
+                from ..parallel.packed import pack_safe
+                packed = bool(pack_safe(self.N_pad,
+                                        gcfg.num_grad_quant_bins))
+                if packed:
+                    channels = 1  # int32-packed-int16 pair
+            elems = (1 + (L - 1)) * channels * Fx * B
+        else:
+            # serial grower: root [2,F,B], then one fused both-children
+            # [4,F,B] pass per remaining split (ops/grow.py)
+            elems = (2 + 4 * max(L - 2, 0)) * Fx * B
+        factor = (k - 1) / k * (1.0 if mode == "reduce_scatter" else 2.0)
+        return {
+            "comm_mode": mode,
+            "comm_packed": packed,
+            "mesh_size": k,
+            "comm_bytes_per_tree": int(elems * 4 * factor),
+        }
 
     def _prof_span(self, name: str):
         """The active profiler's span, or a no-op context."""
@@ -1012,6 +1096,11 @@ class GBDT:
         prof = self.profiler
         if prof is not None:
             prof.iter_start()
+            cp = getattr(self, "_comm_profile", None)
+            if cp:
+                cb = int(cp["comm_bytes_per_tree"]) * K
+                prof.iter_meta(comm_mode=cp["comm_mode"], comm_bytes=cb)
+                prof.add_counter("comm_bytes", cb)
         init_scores = np.zeros(K)
         with self._prof_span("boost"):
             if grad is None or hess is None:

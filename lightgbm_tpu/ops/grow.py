@@ -17,10 +17,14 @@ Key structural translation (see SURVEY.md §7 design stance):
    histograms at once ((grad, hess, count) x (left, right)); the
    compact-gather + subtraction fast path lives in ops/grow_fast.py.
  - Best-split search is the vectorized scan of ops/split.py.
- - When `dist` is set, per-leaf histograms are `psum`-reduced across the data-
-   parallel mesh axis before split search, which is exactly the reference's
-   data-parallel ReduceScatter+Allgather of histograms
-   (data_parallel_tree_learner.cpp:286-298) riding ICI instead of sockets.
+ - When `dist` is set, per-leaf histograms cross the data-parallel mesh axis
+   before split search. Under `parallel_hist_mode=allreduce` they are
+   `psum`-reduced in full to every rank; under `reduce_scatter` they are
+   `psum_scatter`-ed so each rank owns a feature slice, searches only it,
+   and the winner syncs broadcast-free via order-encoded pmax keys — the
+   reference's ReduceScatter + SyncUpGlobalBestSplit
+   (data_parallel_tree_learner.cpp:286-298, parallel_tree_learner.h:210-233)
+   riding ICI instead of sockets.
 
 Leaf/node numbering matches Tree::Split (src/io/tree.cpp:60-100): internal
 node s is created by split s; the left child keeps leaf id `p`, the right
@@ -139,6 +143,21 @@ class GrowConfig(NamedTuple):
     # tiny split records cross the wire (SyncUpGlobalBestSplit)
     feature_parallel: bool = False
 
+    # data-parallel histogram exchange (docs/PERF.md §Communication):
+    # "allreduce" psums the full per-leaf histogram to every rank (this
+    # grower then searches every feature; the wave grower slices its
+    # owned features out of the full buffer and merges as under
+    # reduce_scatter, so its trees never depend on the mode);
+    # "reduce_scatter" exchanges via psum_scatter so each rank owns a
+    # contiguous feature slice (data_parallel_tree_learner.cpp:286-298),
+    # searches only its slice, and the winner is recovered broadcast-free
+    # with order-encoded pmax keys whose tie order matches the mode's
+    # full-scan semantics (parallel/packed.py). "auto" keeps each
+    # grower's default (wave: reduce-scatter ownership; serial:
+    # allreduce) unless the runtime autotuner resolves it
+    # (runtime/autotune.py).
+    parallel_hist_mode: str = "auto"
+
     @property
     def bundled(self) -> bool:
         return len(self.bundle_col) > 0
@@ -256,6 +275,64 @@ def grow_tree(
     def psum(x):
         return dist.psum(x) if dist is not None else x
 
+    # ---- reduce-scatter feature ownership (parallel_hist_mode=
+    # reduce_scatter; data_parallel_tree_learner.cpp:286-298): per-leaf
+    # histograms are exchanged via psum_scatter so each rank receives
+    # only the summed slice of the features it owns (offset-contiguous;
+    # docs/PARITY.md §Feature-slice ownership), the split scan runs on
+    # that slice against sliced metadata, and the global winner is
+    # recovered on every rank with order-encoded pmax keys + one masked
+    # psum (SyncUpGlobalBestSplit without the record broadcast;
+    # parallel/packed.py). EFB-bundled storage keeps the allreduce path:
+    # bundle histograms are re-sliced per ORIGINAL feature at search
+    # time, which does not commute with slicing storage columns.
+    rs_on = (dist is not None and cfg.n_shards > 1
+             and cfg.parallel_hist_mode == "reduce_scatter"
+             and not cfg.bundled and not cfg.feature_parallel)
+    if rs_on:
+        from ..parallel.packed import masked_psum_record, pmax_winner_mask
+        from ..utils import round_up
+        nsh = cfg.n_shards
+        Fh_pad = round_up(F, nsh)
+        Fs = Fh_pad // nsh
+        foff = dist.axis_index() * Fs
+
+        def _slice_f(a, ax, fill=0):
+            if a is None:
+                return None
+            pads = [(0, 0)] * a.ndim
+            pads[ax] = (0, Fh_pad - F)
+            ap = jnp.pad(a, pads, constant_values=fill)
+            return jax.lax.dynamic_slice_in_dim(ap, foff, Fs, ax)
+
+        # padded features get num_bins=0: every bin invalid -> -inf gain
+        meta_use = meta._replace(
+            num_bins=_slice_f(meta.num_bins, 0),
+            missing_type=_slice_f(meta.missing_type, 0),
+            default_bin=_slice_f(meta.default_bin, 0),
+            is_categorical=_slice_f(meta.is_categorical, 0),
+            monotone=_slice_f(meta.monotone, 0),
+            inter_sets=(_slice_f(meta.inter_sets, 1)
+                        if meta.inter_sets is not None else None),
+            cegb_coupled=_slice_f(meta.cegb_coupled, 0),
+        )
+        fmask_use = (_slice_f(feature_mask, 0)
+                     if feature_mask is not None else None)
+
+        def exchange(hist):
+            """[..., F, B] full local histogram -> [..., Fs, B] summed
+            owned slice (one reduce-scatter; (k-1)/k of the allreduce
+            ring bytes)."""
+            pads = [(0, 0)] * hist.ndim
+            pads[-2] = (0, Fh_pad - F)
+            return dist.psum_scatter(jnp.pad(hist, pads),
+                                     axis=hist.ndim - 2)
+    else:
+        meta_use, fmask_use = meta, feature_mask
+
+        def exchange(hist):
+            return psum(hist)
+
     g = grad.astype(jnp.float32) * in_bag
     h = hess.astype(jnp.float32) * in_bag
     # in-bag ROW indicator for the exact root count (GOSS amplification
@@ -277,7 +354,7 @@ def grow_tree(
                          axis=0)                                 # [4, N]
         hist4 = build_histogram(X_t, vals, B, cfg.rows_per_chunk,
                                 tiers=cfg.hist_tiers, impl=cfg.hist_impl)
-        hist4 = psum(hist4)
+        hist4 = exchange(hist4)
         return hist4[:2], hist4[2:]
 
     W = cfg.cat_words
@@ -285,20 +362,40 @@ def grow_tree(
     def search(hist, sum_g, sum_h, count, out):
         """Best split over numerical + categorical features
         (FindBestThreshold dispatch, feature_histogram.hpp:166-178).
-        `hist` arrives [2, F, B]; the count channel is synthesized via the
+        `hist` arrives [2, F, B] (the rank's owned [2, Fs, B] slice under
+        reduce-scatter); the count channel is synthesized via the
         reference's cnt_factor (feature_histogram.hpp:529,844)."""
         hist = synth_count_channel(hist, count, sum_h)
-        num = find_best_split(hist, sum_g, sum_h, count, out, meta, hp,
-                              feature_mask)
+        num = find_best_split(hist, sum_g, sum_h, count, out, meta_use, hp,
+                              fmask_use)
+        nob = jnp.zeros((W,), jnp.uint32)
         if not cfg.has_categorical:
-            return num, jnp.zeros((), bool), jnp.zeros((W,), jnp.uint32)
-        catr, bitset = find_best_split_categorical(
-            hist, sum_g, sum_h, count, out, meta, hp, cfg.cat, feature_mask)
-        use_cat = catr.gain > num.gain
-        merged = SplitResult(*[
-            jnp.where(use_cat, cv, nv) for cv, nv in zip(catr, num)])
-        return merged, use_cat, jnp.where(use_cat, bitset,
-                                          jnp.zeros((W,), jnp.uint32))
+            res, use_cat, bits = num, jnp.zeros((), bool), nob
+        else:
+            catr, bitset = find_best_split_categorical(
+                hist, sum_g, sum_h, count, out, meta_use, hp, cfg.cat,
+                fmask_use)
+            use_cat = catr.gain > num.gain
+            res = SplitResult(*[
+                jnp.where(use_cat, cv, nv) for cv, nv in zip(catr, num)])
+            bits = jnp.where(use_cat, bitset, nob)
+        if rs_on:
+            # slice-local feature id -> global, then broadcast-free
+            # winner election: two pmax rounds on order-encoded uint32
+            # keys and ONE masked psum recovering the unique winner's
+            # record bit-exactly (candidate features are disjoint
+            # across ranks). scan_order: gain ties must resolve exactly
+            # as the full-search allreduce path does — numerical over
+            # categorical, then default direction, then lowest feature
+            # — or an exact tie straddling two ranks' slices would grow
+            # different trees under the two modes.
+            res = res._replace(feature=res.feature + foff)
+            mask = pmax_winner_mask(dist, res.gain, res.feature,
+                                    res.threshold, res.default_left,
+                                    use_cat, scan_order=True)
+            res, use_cat, bits = masked_psum_record(
+                dist, mask, (res, use_cat, bits))
+        return res, use_cat, bits
 
     # ---- root (BeforeTrain: serial_tree_learner.cpp:292-342)
     root_g = psum(jnp.sum(g))
@@ -309,9 +406,9 @@ def grow_tree(
         / (root_h + hp.lambda_l2), jnp.float32)
 
     vals0 = jnp.stack([g, h], axis=0)
-    hist_root = psum(build_histogram(X_t, vals0, B, cfg.rows_per_chunk,
-                                     tiers=cfg.hist_tiers,
-                                     impl=cfg.hist_impl))
+    hist_root = exchange(build_histogram(X_t, vals0, B, cfg.rows_per_chunk,
+                                         tiers=cfg.hist_tiers,
+                                         impl=cfg.hist_impl))
     root_split, root_is_cat, root_bitset = search(
         hist_root, root_g, root_h, root_c, root_out)
     root_split = root_split._replace(

@@ -48,11 +48,22 @@ Order semantics by mode:
     and measured quality matches the serial growers on the parity gates.
 Speculation waste is bounded by one wave's worth of histogram slots.
 
-Distributed (tree_learner=data): one psum of the [K,C,F,B] wave histogram
-per wave — O(waves) collectives per tree instead of O(L)
+Distributed (tree_learner=data): one collective over the [K,C,F,B] wave
+histogram per wave — O(waves) collectives per tree instead of O(L)
 (data_parallel_tree_learner.cpp:286-298 does one ReduceScatter per split).
-Wave selection and the apply loop depend only on psum-reduced quantities,
-so every shard executes identical splits.
+The collective follows `parallel_hist_mode` (docs/PERF.md
+§Communication) while feature ownership — each shard searches only the
+features it owns, per-wave best-split records merge via
+SyncUpGlobalBestSplit (a record gather, or broadcast-free order-encoded
+pmax keys under explicit `reduce_scatter`) — stays on in every mode:
+`reduce_scatter`/`auto` deliver each shard only its summed feature slice
+via psum_scatter; `allreduce` psums the full histogram everywhere and
+each shard slices locally (same values bitwise, baseline wire profile),
+so the modes grow bit-identical trees. Quantized-gradient histograms
+cross the wire as int32-packed-int16 lanes when the static carry bound
+holds (parallel/packed.py), halving ICI bytes. Wave selection and the
+apply loop depend only on globally-reduced quantities, so every shard
+executes identical splits.
 """
 
 from __future__ import annotations
@@ -361,8 +372,36 @@ def grow_tree_wave(
     # mutually exclusive.
     fp = (dist is not None and cfg.n_shards > 1 and cfg.feature_parallel
           and not cfg.bundled and not vo)
+    # parallel_hist_mode selects only the COLLECTIVE, never the search:
+    # ownership (slice search + record merge) stays on in every mode, so
+    # the grown trees are bit-identical across modes by construction —
+    # under `allreduce` the full wave histogram is psum'd to every rank
+    # and each rank slices out its own features locally (the autotune
+    # probe's baseline wire profile; docs/PERF.md §Communication).
+    # Exact-gain ties make the distinction observable otherwise: the
+    # full-scan argmax is direction-major while the ownership merge is
+    # feature-major, so a full search under allreduce could flip winners.
     fo = (dist is not None and cfg.n_shards > 1 and not cfg.bundled
           and not vo and not fp)
+    # explicit reduce_scatter additionally syncs the per-wave best-split
+    # records broadcast-free: order-encoded pmax keys + one masked psum
+    # (parallel/packed.py) instead of the record all_gather.
+    use_pmax_sync = fo and cfg.parallel_hist_mode == "reduce_scatter"
+    # int32-packed-int16 collective payloads under quantized gradients
+    # (bin.h:49-82 reducers): exact while the static carry bound holds,
+    # halving ICI bytes for every histogram exchange in this tree.
+    from ..parallel.packed import pack_gh, pack_safe, unpack_gh
+    pack_ici = (quant and dist is not None and not cfg.feature_parallel
+                and pack_safe(N * cfg.n_shards, cfg.num_grad_quant_bins))
+
+    def exchange_hist(histc, collective, caxis):
+        """Run `collective` over an int32/f32 histogram whose (grad,
+        hess) channel pair lives on `caxis`, packing the pair into one
+        int32 lane when safe (quantized mode only)."""
+        if pack_ici:
+            return unpack_gh(collective(pack_gh(histc, caxis)), caxis)
+        return collective(histc)
+
     nsh = cfg.n_shards
     if fo or fp:
         from ..utils import round_up
@@ -633,7 +672,7 @@ def grow_tree_wave(
                                       cfg.rows_per_chunk,
                                       tiers=cfg.hist_tiers,
                                       impl=cfg.hist_impl)
-    hist_root = psum(hist_root_local)
+    hist_root = exchange_hist(hist_root_local, psum, 0)
     root_fid = jnp.asarray(0 if has_forced else -1, jnp.int32)
     used0 = (cegb_used if has_cegb else jnp.zeros((F,), bool))
     root_kwargs = dict(
@@ -1335,17 +1374,30 @@ def grow_tree_wave(
                 kidx = jnp.minimum(kidx, len(buckets) - 1)
                 hist_local = jax.lax.switch(kidx, hist_branches, slot_small)
             if fo:
-                pads = [(0, 0)] * hist_local.ndim
-                pads[2] = (0, Fh_pad - hist_local.shape[2])
-                hist_small = dist.psum_scatter(
-                    jnp.pad(hist_local, pads), axis=2)
+                if cfg.parallel_hist_mode == "allreduce":
+                    # full-histogram psum baseline: every rank receives
+                    # the complete summed wave histogram and slices its
+                    # own features out locally. Zero-padding commutes
+                    # with the sum, so the slice is bitwise equal to the
+                    # psum_scatter shard — only the wire profile differs.
+                    full = exchange_hist(hist_local, psum, 1)
+                    pads = [(0, 0)] * full.ndim
+                    pads[2] = (0, Fh_pad - full.shape[2])
+                    hist_small = jax.lax.dynamic_slice_in_dim(
+                        jnp.pad(full, pads), foff, Fs, 2)
+                else:
+                    pads = [(0, 0)] * hist_local.ndim
+                    pads[2] = (0, Fh_pad - hist_local.shape[2])
+                    hist_small = exchange_hist(
+                        jnp.pad(hist_local, pads),
+                        lambda x: dist.psum_scatter(x, axis=2), 1)
             elif vo:
                 hist_small = hist_local     # voting: caches stay local
             elif fp:
                 # full rows local: the feature-slice histogram IS global
                 hist_small = hist_local
             else:
-                hist_small = psum(hist_local)
+                hist_small = exchange_hist(hist_local, psum, 1)
             hist_parent = _onehot_gather(
                 st.hist_cache, jnp.where(valid, cand, L)
             ).reshape((KMAX,) + hshape)                      # [K, C, F, B]
@@ -1542,25 +1594,44 @@ def grow_tree_wave(
                 # beat other shards' normal bests regardless of gain;
                 # SyncUpGlobalBestSplit, parallel_tree_learner.h:210-233)
                 s_lr = s_lr._replace(feature=s_lr.feature + foff)
-                rec = (tuple(s_lr), cat_lr, bits_lr, forced_lr)
-                allr = jax.tree.map(
-                    lambda a: dist.all_gather(a, axis=0, tiled=False), rec)
-                key_all = allr[0][0]                      # [n, 2K] gains
-                if has_forced:
-                    key_all = jnp.where(allr[3], 2e18, key_all)
-                pick = jnp.argmax(key_all, axis=0)        # [2K]
+                if use_pmax_sync:
+                    # broadcast-free: two pmax rounds on order-encoded
+                    # uint32 keys elect the winner per slot (ties on
+                    # gain -> lowest feature, identical to the gather
+                    # merge's lowest-rank argmax since feature slices
+                    # ascend with rank), then ONE masked psum recovers
+                    # the unique winner's record bit-exactly
+                    from ..parallel.packed import (masked_psum_record,
+                                                   pmax_winner_mask)
+                    key_gain = s_lr.gain
+                    if has_forced:
+                        key_gain = jnp.where(forced_lr, 2e18, key_gain)
+                    win = pmax_winner_mask(dist, key_gain, s_lr.feature,
+                                           s_lr.threshold,
+                                           s_lr.default_left, cat_lr)
+                    s_lr, cat_lr, bits_lr, forced_lr = masked_psum_record(
+                        dist, win, (s_lr, cat_lr, bits_lr, forced_lr))
+                else:
+                    rec = (tuple(s_lr), cat_lr, bits_lr, forced_lr)
+                    allr = jax.tree.map(
+                        lambda a: dist.all_gather(a, axis=0, tiled=False),
+                        rec)
+                    key_all = allr[0][0]                  # [n, 2K] gains
+                    if has_forced:
+                        key_all = jnp.where(allr[3], 2e18, key_all)
+                    pick = jnp.argmax(key_all, axis=0)    # [2K]
 
-                def take(a):
-                    idx = pick.reshape((1,) + pick.shape
-                                       + (1,) * (a.ndim - 2))
-                    return jnp.take_along_axis(
-                        a, jnp.broadcast_to(idx, (1,) + a.shape[1:]),
-                        axis=0)[0]
+                    def take(a):
+                        idx = pick.reshape((1,) + pick.shape
+                                           + (1,) * (a.ndim - 2))
+                        return jnp.take_along_axis(
+                            a, jnp.broadcast_to(idx, (1,) + a.shape[1:]),
+                            axis=0)[0]
 
-                s_lr = SplitResult(*[take(a) for a in allr[0]])
-                cat_lr = take(allr[1])
-                bits_lr = take(allr[2])
-                forced_lr = take(allr[3])
+                    s_lr = SplitResult(*[take(a) for a in allr[0]])
+                    cat_lr = take(allr[1])
+                    bits_lr = take(allr[2])
+                    forced_lr = take(allr[3])
             # depth mask applied at store time so the order simulation can
             # use stored gains directly (the own block re-splits the leaf
             # itself: its depth gate is depth < max_depth)

@@ -3,12 +3,14 @@ tree learners, re-expressed as XLA collectives over a jax.sharding.Mesh)."""
 
 from .context import DATA_AXIS, FEATURE_AXIS, DistContext, make_data_mesh
 from .data_parallel import (build_data_parallel_train_fn,
-                            build_sharded_score_fn, pad_rows_to,
+                            build_sharded_score_fn, lane_multiple,
+                            pad_rows_to,
                             replicated, shard_rows)
 from .distributed import init_distributed
 
 __all__ = [
     "DATA_AXIS", "FEATURE_AXIS", "DistContext", "make_data_mesh",
     "build_data_parallel_train_fn", "build_sharded_score_fn",
-    "pad_rows_to", "shard_rows", "replicated", "init_distributed",
+    "lane_multiple", "pad_rows_to", "shard_rows", "replicated",
+    "init_distributed",
 ]

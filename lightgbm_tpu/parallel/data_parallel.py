@@ -12,9 +12,13 @@ every rank executes the same splits and grows the IDENTICAL tree
 (SURVEY.md §3.4) — no split-record broadcast is needed at all.
 
 The whole per-tree loop stays inside ONE jitted shard_map computation; the
-only cross-device traffic is the per-split histogram psum (O(F·B·6) floats)
-and scalar root reductions, exactly the wire profile of the reference's
-tree_learner=data.
+only cross-device traffic is the per-split histogram exchange — a full
+`psum` under `parallel_hist_mode=allreduce`, or a `psum_scatter` of the
+feature-padded buffer plus a pmax best-split sync under
+`parallel_hist_mode=reduce_scatter` (ops/grow.py, parallel/packed.py,
+docs/PERF.md §Communication) — and scalar root reductions, matching the
+wire profile of the reference's tree_learner=data (ReduceScatter +
+SyncUpGlobalBestSplit rather than a monolithic Allreduce).
 """
 
 from __future__ import annotations
@@ -42,9 +46,26 @@ def shard_map_compat(f, mesh, in_specs, out_specs, check_vma=False):
                      out_specs=out_specs, check_rep=bool(check_vma))
 
 
-def pad_rows_to(n: int, num_shards: int, multiple: int = 8) -> int:
+def lane_multiple() -> int:
+    """Device-derived row-pad granularity: TPU vector registers are
+    (8, 128) tiles, so per-shard row counts that are multiples of 128
+    avoid relayout padding inside every batched op; host/GPU backends
+    tile fine at 8 (and 128 would waste real memory on tiny CPU-mesh
+    tests)."""
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:  # uninitialized backend: conservative default
+        return 8
+    return 128 if platform == "tpu" else 8
+
+
+def pad_rows_to(n: int, num_shards: int, multiple: int = 0) -> int:
     """Rows must split evenly across shards (and pad to a lane-friendly
-    multiple per shard so XLA tiles cleanly)."""
+    multiple per shard so XLA tiles cleanly). `multiple=0` (default)
+    derives the granularity from the active backend via
+    `lane_multiple`."""
+    if multiple <= 0:
+        multiple = lane_multiple()
     per = -(-n // num_shards)
     per = -(-per // multiple) * multiple
     return per * num_shards

@@ -1,0 +1,205 @@
+"""Packed-integer collective payloads and order-encoded split keys.
+
+Two wire-efficiency devices used by the data-parallel growers under
+``parallel_hist_mode=reduce_scatter`` (docs/PERF.md §Communication):
+
+1. **int32-packed-int16 histogram payloads** under quantized-gradient
+   training. The reference reduces histogram buffers with
+   int32-packed-int16 / int64-packed-int32 reducers
+   (include/LightGBM/bin.h:49-82), choosing the accumulator width per
+   leaf from the leaf's row count (gradient_discretizer.cpp hist-bit
+   selection). Here the int32 grad and hess histogram channels are
+   folded into ONE int32 lane, ``packed = g * 2^16 + h``: integer sums
+   commute with the packing as long as no carry crosses bit 16, i.e.
+   the globally-summed hess stays in [0, 2^16) and |summed grad| <
+   2^15. Both bounds follow statically from the quantization ranges
+   (per-row |g| <= qb//2 + 1, 0 <= h <= qb + 1 with stochastic
+   rounding, clipped at 127), so ``pack_safe`` is evaluated at trace
+   time — the reference's per-leaf hist-bit selection, made static.
+   When the bound fails we fall back to the two unpacked int32
+   channels: jax x64 is not enabled in this stack, and an
+   int64-packed-int32 lane would move the same bytes as two int32
+   channels anyway (docs/PARITY.md §Packed histogram accumulators).
+
+2. **Order-encoded best-split keys** for broadcast-free winner
+   recovery (SyncUpGlobalBestSplit, parallel_tree_learner.h:210-233).
+   Each rank searches only the feature slice it owns, so candidate
+   features are globally disjoint; the global winner is recovered with
+   ``pmax`` over an order-preserving uint32 encoding of the gain bits
+   plus a second uint32 lexicographic tie-break lane. The lane's bit
+   layout is pinned per caller (see the layout comment below) so that
+   exact-gain ties resolve EXACTLY as that grower's reference merge
+   does — the wave grower's record-gather order or the leaf grower's
+   single-device scan order — and every rank decodes the winning
+   feature directly from the key.
+   The winner's full split record (sums, counts, outputs, categorical
+   bitset) is then recovered with one masked ``psum``: the
+   (gain, feature) pair identifies a unique rank, so the sum has
+   exactly one non-zero contributor per slot and is exact. No rank
+   broadcasts a variable-size record; the replicated-tree invariant is
+   preserved. (A literal single pmax over a 64-bit packed key would
+   need x64, which this stack keeps disabled — the second lane plays
+   the low word of that key.)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# key_lo bit layouts (both uint32, complement fields so LOWER wins):
+#
+# merge order (default) — [31:12] ~feature (20 bits), [11:2] threshold
+# bin (10 bits), [1] default_left, [0] is_cat. Ties on gain resolve
+# toward the LOWEST feature id first: this matches the wave grower's
+# pre-existing record-gather merge (argmax over ranks => lowest rank =>
+# lowest owned feature slice), so pmax and gather merges agree exactly.
+#
+# scan order — [31] ~is_cat, [30] ~default_left, [29:10] ~feature,
+# [9:0] ~threshold bin. This reproduces the SINGLE-DEVICE full-scan
+# semantics: `use_cat = cat_gain > num_gain` prefers numerical on equal
+# gain, and the numerical argmax over the flat [2, F, B] gain map is
+# direction-major (d=0 block first), then feature, then bin. The leaf
+# grower's reduce-scatter merge uses this so its trees stay bitwise
+# equal to the full-search allreduce path even on exact-gain ties that
+# straddle feature slices with different default directions.
+_FEAT_BITS = 20
+_BIN_BITS = 10
+FEAT_MAX = (1 << _FEAT_BITS) - 1
+_BIN_MAX = (1 << _BIN_BITS) - 1
+
+
+# ---------------------------------------------------------------------------
+# packed int16-pair histogram lanes
+# ---------------------------------------------------------------------------
+
+def pack_safe(n_rows_global: int, num_grad_quant_bins: int) -> bool:
+    """Static (trace-time) bound: can the summed quantized grad/hess of
+    ANY bin carry past bit 16 of the packed lane?
+
+    Per-row quantized magnitudes are bounded by the discretizer scales
+    (g_scale = max|g| / (qb//2), h_scale = max(h) / qb) plus one unit
+    of stochastic rounding, hard-clipped at 127
+    (gradient_discretizer.cpp). The per-bin sum over all rows of all
+    ranks is then bounded by n_rows_global * bound, and packing is
+    exact iff the hess sum stays below 2^16 and the grad sum magnitude
+    below 2^15. The stricter 2^15 is applied to both channels.
+    """
+    qb = int(num_grad_quant_bins)
+    per_row = min(127, qb + 1)
+    return int(n_rows_global) * per_row < (1 << 15)
+
+
+def pack_gh(hist: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Fold the (grad, hess) int32 channel pair along `axis` into one
+    packed int32 lane: ``packed = g * 2^16 + h``.
+
+    `hist` must have exactly 2 entries along `axis` (grad first). The
+    result keeps the axis (length 1) so collective axis numbering is
+    unchanged. Sums of packed lanes equal packed sums while the
+    `pack_safe` bound holds.
+    """
+    g = jnp.take(hist, jnp.asarray([0]), axis=axis)
+    h = jnp.take(hist, jnp.asarray([1]), axis=axis)
+    return (g.astype(jnp.int32) << 16) + h.astype(jnp.int32)
+
+
+def unpack_gh(packed: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Inverse of `pack_gh` after the collective: hess is the low 16
+    bits (non-negative, so the mask is exact), grad is the arithmetic
+    right shift (floor division by 2^16 — exact because the hess
+    residue is non-negative)."""
+    h = packed & jnp.int32(0xFFFF)
+    g = packed >> 16
+    return jnp.concatenate([g, h], axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# order-encoded split keys
+# ---------------------------------------------------------------------------
+
+def encode_gain_key(gain: jnp.ndarray) -> jnp.ndarray:
+    """Order-preserving uint32 encoding of f32 gain bits: flip the sign
+    bit of non-negative floats and ALL bits of negative floats, so
+    unsigned integer comparison agrees with float comparison (total
+    order on non-NaN values; -inf sentinels sort lowest)."""
+    u = jax.lax.bitcast_convert_type(jnp.asarray(gain, jnp.float32),
+                                     jnp.uint32)
+    neg = (u >> 31) == 1
+    return jnp.where(neg, ~u, u | jnp.uint32(0x80000000))
+
+
+def encode_split_key(feature: jnp.ndarray, threshold: jnp.ndarray,
+                     default_left: jnp.ndarray,
+                     is_cat=None, scan_order: bool = False) -> jnp.ndarray:
+    """Low key word (see the layout comment at the top of the module).
+
+    Default merge order breaks equal-gain ties toward the LOWEST
+    feature id — the wave grower's record-gather tie-break.
+    ``scan_order=True`` instead reproduces the single-device full-scan
+    tie-break: numerical-over-categorical, then default direction, then
+    feature, then bin. Either way the winning feature is decodable on
+    every rank."""
+    f = jnp.clip(feature, 0, FEAT_MAX).astype(jnp.uint32)
+    b = jnp.clip(threshold, 0, _BIN_MAX).astype(jnp.uint32)
+    dl = jnp.asarray(default_left).astype(jnp.uint32) & 1
+    ic = (jnp.asarray(is_cat).astype(jnp.uint32) & 1) if is_cat is not None \
+        else jnp.zeros_like(dl)
+    if scan_order:
+        return ((jnp.uint32(1) - ic) << 31) \
+            | ((jnp.uint32(1) - dl) << 30) \
+            | ((jnp.uint32(FEAT_MAX) - f) << _BIN_BITS) \
+            | (jnp.uint32(_BIN_MAX) - b)
+    return ((jnp.uint32(FEAT_MAX) - f) << (_BIN_BITS + 2)) \
+        | (b << 2) | (dl << 1) | ic
+
+
+def decode_key_feature(key_lo: jnp.ndarray,
+                       scan_order: bool = False) -> jnp.ndarray:
+    """Winning global feature id from the low key word."""
+    shift = _BIN_BITS if scan_order else _BIN_BITS + 2
+    inv = (key_lo >> shift) & jnp.uint32(FEAT_MAX)
+    return (jnp.uint32(FEAT_MAX) - inv).astype(jnp.int32)
+
+
+def pmax_winner_mask(dist, gain: jnp.ndarray, feature: jnp.ndarray,
+                     threshold: jnp.ndarray, default_left: jnp.ndarray,
+                     is_cat=None, scan_order: bool = False):
+    """Broadcast-free global best-split election.
+
+    All arguments are per-rank local candidates (any matching shape;
+    elementwise over that shape). Returns a boolean `mask`, True only
+    on the single rank whose candidate won — feature slices are
+    disjoint across ranks, so (max gain key, then the key_lo tie order)
+    identifies exactly one owner per slot. ``scan_order`` selects the
+    gain-tie semantics (module layout comment): the wave grower keeps
+    the feature-major merge order (must agree with its record-gather
+    merge), the leaf grower uses the single-device scan order (must
+    agree with its full-search allreduce path). Recover the winner's
+    full record with ``masked_psum_record``. Two pmax rounds on uint32
+    keys; no record broadcast.
+    """
+    key_hi = encode_gain_key(gain)
+    hi_max = dist.pmax(key_hi)
+    key_lo = jnp.where(key_hi == hi_max,
+                       encode_split_key(feature, threshold, default_left,
+                                        is_cat, scan_order=scan_order),
+                       jnp.uint32(0))
+    lo_max = dist.pmax(key_lo)
+    win_feat = decode_key_feature(lo_max, scan_order=scan_order)
+    return (key_hi == hi_max) & (feature == win_feat)
+
+
+def masked_psum_record(dist, mask: jnp.ndarray, record):
+    """Exact winner-record recovery: zero every non-winning rank's
+    contribution and psum. `record` is a pytree of arrays whose leading
+    dims broadcast against `mask`; exactly one rank contributes per
+    slot, so float fields are recovered bit-exactly."""
+    def one(a):
+        m = mask
+        while m.ndim < a.ndim:
+            m = m[..., None]
+        if a.dtype == jnp.bool_:
+            return dist.psum(jnp.where(m, a, False).astype(jnp.int32)) > 0
+        return dist.psum(jnp.where(m, a, jnp.zeros((), a.dtype)))
+    return jax.tree.map(one, record)

@@ -48,6 +48,12 @@ TIE_TOL = 0.02
 DEFAULT_PROBE_ROWS = 65536
 CHUNK_CANDIDATES = (4096, 8192, 32768)
 
+# data-parallel histogram exchange candidates (ops/grow.py,
+# docs/PERF.md §Communication); on a tie prefer reduce_scatter — it is
+# the wire-cheaper mode ((k-1)/k vs 2(k-1)/k bytes) and produces
+# bit-identical trees, so the tie-break only affects the wire profile
+COMM_MODE_PREFERENCE = ("reduce_scatter", "allreduce")
+
 # histogram implementation candidates (ops/histogram.py _tier_route,
 # docs/PERF.md); tie preference matches the "auto" default so a tie
 # reproduces untuned behavior — the row-wise layout probes last and must
@@ -274,6 +280,101 @@ def probe_hist_impls(X_t, cfg, impl_candidates: Sequence[str]
             log_warning(f"autotune: probe for histogram impl '{impl}' "
                         f"failed ({type(e).__name__}); dropping candidate")
     return timings
+
+
+def probe_comm_modes(mesh, n_features: int, num_bins_padded: int,
+                     channels: int = 3, seed: int = 0,
+                     timer: Callable[[], float] = time.perf_counter,
+                     ) -> Dict[str, float]:
+    """Time the two histogram-exchange collectives on the REAL mesh:
+    one full-buffer ``psum`` (allreduce) vs one ``psum_scatter`` over the
+    feature-padded axis (reduce_scatter), at the exact per-leaf payload
+    shape the growers exchange ([C, F_pad, B], docs/PERF.md
+    §Communication). Unlike the grower/layout probes this one needs a
+    multi-device mesh, so it runs where those are skipped (models/gbdt.py
+    gates the call on ``use_dist``)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.context import DATA_AXIS, DistContext
+    from ..parallel.data_parallel import shard_map_compat
+    from .profiler import device_barrier
+
+    k = int(mesh.devices.size)
+    dist = DistContext(DATA_AXIS)
+    Fh = max(-(-int(n_features) // k) * k, k)
+    B = max(int(num_bins_padded), 8)
+    rng = np.random.RandomState(seed)
+    buf = jnp.asarray(rng.uniform(-1.0, 1.0,
+                                  size=(channels, Fh, B)).astype(np.float32))
+
+    candidates = {
+        "allreduce": (lambda x: dist.psum(x), P()),
+        "reduce_scatter": (lambda x: dist.psum_scatter(x, axis=1),
+                           P(None, DATA_AXIS, None)),
+    }
+    timings: Dict[str, float] = {}
+    for name, (fn, out_spec) in candidates.items():
+        try:
+            jitted = jax.jit(shard_map_compat(
+                fn, mesh=mesh, in_specs=(P(),), out_specs=out_spec,
+                check_vma=False))
+            _block(jitted(buf))                   # compile + warm
+            best = float("inf")
+            for _ in range(2):
+                device_barrier()
+                t0 = timer()
+                _block(jitted(buf))
+                best = min(best, timer() - t0)
+            timings[name] = best
+        except Exception as e:                    # noqa: BLE001
+            from ..utils.log import log_warning
+            log_warning(f"autotune: comm probe for '{name}' failed "
+                        f"({type(e).__name__}); dropping candidate")
+    return timings
+
+
+def autotune_comm_decision(mesh, *, n_rows: int, n_features: int,
+                           max_bin: int, num_leaves: int,
+                           num_bins_padded: int, channels: int = 3,
+                           cache_path: str = "", seed: int = 0,
+                           timer: Callable[[], float] = time.perf_counter,
+                           ) -> Dict[str, Any]:
+    """Resolve ``parallel_hist_mode=auto`` for a data-parallel run by a
+    timed probe, cached like the grower decision. The cache key is the
+    standard shape signature plus the mesh size (the collective's cost
+    depends on how many ranks the payload crosses, not just its shape).
+
+    Returns ``{"parallel_hist_mode", "comm_timings", "key", "cached"}``;
+    ``parallel_hist_mode`` is None when both probes failed (caller keeps
+    the grower's default exchange)."""
+    k = int(mesh.devices.size)
+    key = make_key(n_rows, n_features, max_bin, num_leaves) + f"_mesh{k}"
+    if key in _MEM_CACHE:
+        return dict(_MEM_CACHE[key], cached="memory")
+    path = cache_path or default_cache_path()
+    disk = load_disk_cache(path)
+    hit = disk.get(key)
+    if isinstance(hit, dict) and hit.get("parallel_hist_mode") in (
+            None, *COMM_MODE_PREFERENCE):
+        _MEM_CACHE[key] = hit
+        return dict(hit, cached="disk")
+
+    timings = probe_comm_modes(mesh, n_features, num_bins_padded,
+                               channels=channels, seed=seed, timer=timer)
+    mode = _pick_winner(timings, COMM_MODE_PREFERENCE)
+    decision: Dict[str, Any] = {
+        "parallel_hist_mode": mode,
+        "comm_timings": {n: round(v, 6) for n, v in timings.items()},
+        "key": key,
+        "mesh_size": k,
+    }
+    _MEM_CACHE[key] = decision
+    disk[key] = decision
+    save_disk_cache(path, disk)
+    return dict(decision, cached=False)
 
 
 def _pick_winner(timings: Dict[str, float],

@@ -161,6 +161,7 @@ class StageProfiler:
         self.hbm_peak_bytes: Optional[int] = None
         self._iter_t0: Optional[float] = None
         self._iter_spans: Optional[Dict[str, float]] = None
+        self._iter_fields: Optional[Dict[str, Any]] = None
 
     # -- span recording ---------------------------------------------------
 
@@ -184,7 +185,18 @@ class StageProfiler:
     def iter_start(self) -> None:
         self._barrier()
         self._iter_spans = {}
+        self._iter_fields = {}
         self._iter_t0 = self._clock()
+
+    def iter_meta(self, **fields: Any) -> None:
+        """Attach host-known metadata (e.g. ``comm_mode``/``comm_bytes``
+        for the distributed histogram exchange) to the CURRENT
+        iteration's ring record. The growers are single fused jits, so
+        collective traffic can't be span-timed from the host; these
+        analytic fields are the per-iteration record of what went over
+        the wire. No-op outside an iteration."""
+        if self._iter_fields is not None:
+            self._iter_fields.update(fields)
 
     def iter_end(self, n_rows: int = 0) -> None:
         if self._iter_t0 is None:
@@ -198,13 +210,17 @@ class StageProfiler:
         if other > 0.0:
             spans["other"] = other
             self.totals["other"] = self.totals.get("other", 0.0) + other
-        self.ring.append({"iter": self.n_iters, "wall_s": wall,
-                          "stages_s": spans})
+        rec: Dict[str, Any] = {"iter": self.n_iters, "wall_s": wall,
+                               "stages_s": spans}
+        if self._iter_fields:
+            rec.update(self._iter_fields)
+        self.ring.append(rec)
         self.n_iters += 1
         self.total_wall += wall
         self.total_rows += int(n_rows)
         self._iter_t0 = None
         self._iter_spans = None
+        self._iter_fields = None
         peak = _hbm_peak_bytes()
         if peak is not None:
             self.hbm_peak_bytes = max(self.hbm_peak_bytes or 0, peak)

@@ -77,6 +77,55 @@ def test_stage_profiler_other_closes_the_wall():
     assert prof.row_iters_per_sec() == pytest.approx(100 / 4.5)
 
 
+def test_iter_meta_lands_in_current_ring_record():
+    """iter_meta fields merge into the ACTIVE iteration's record only:
+    a no-op outside an iteration, reset for the next one."""
+    prof = StageProfiler(clock=lambda: 0.0, barrier=lambda: None)
+    prof.iter_meta(comm_mode="lost")        # outside: dropped
+    prof.iter_start()
+    prof.iter_meta(comm_mode="reduce_scatter", comm_bytes=4096)
+    prof.iter_end()
+    prof.iter_start()
+    prof.iter_end()
+    first, second = prof.ring
+    assert first["comm_mode"] == "reduce_scatter"
+    assert first["comm_bytes"] == 4096
+    assert "comm_mode" not in second and "comm_bytes" not in second
+
+
+def test_comm_fields_in_distributed_profile(binary_data):
+    """Data-parallel training with profiling exports comm_mode /
+    comm_bytes on every iteration record (docs/PERF.md section 5), the
+    run-total counter, and the analytic wire profile in extras."""
+    X, y = binary_data
+    bst = lgb.train(dict(PARAMS, device_profile=True, tree_learner="data",
+                         parallel_hist_mode="reduce_scatter"),
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    p = bst.get_profile()
+    assert p is not None and len(p["ring"]) == 3
+    for rec in p["ring"]:
+        assert rec["comm_mode"] == "reduce_scatter"
+        assert rec["comm_bytes"] > 0
+    assert p["counters"]["comm_bytes"] == pytest.approx(
+        sum(rec["comm_bytes"] for rec in p["ring"]))
+    comm = p["comm"]
+    assert comm["comm_mode"] == "reduce_scatter"
+    assert comm["mesh_size"] > 1
+    assert comm["comm_bytes_per_tree"] > 0
+
+
+def test_no_comm_fields_on_serial_profile(binary_data):
+    """Single-mesh training has no histogram exchange: records must not
+    grow comm fields."""
+    X, y = binary_data
+    bst = lgb.train(dict(PARAMS, device_profile=True),
+                    lgb.Dataset(X, label=y), num_boost_round=2)
+    p = bst.get_profile()
+    assert all("comm_mode" not in rec and "comm_bytes" not in rec
+               for rec in p["ring"])
+    assert "comm" not in p
+
+
 def test_profile_spans_sum_to_wall_on_cpu(binary_data):
     """Real CPU-backend training: every iteration's stage breakdown sums
     to its wall time (within the acceptance bar's 20%), spans are
@@ -273,6 +322,28 @@ def test_autotune_warns_when_constrained(binary_data):
                     lgb.Dataset(X, label=y), num_boost_round=2)
     assert bst._gbdt.autotune_decision is None
     assert bst._gbdt.grower == "masked"
+
+
+def test_autotune_comm_probe_on_mesh(binary_data, tmp_path):
+    """On a data-parallel mesh the grower autotune is constrained, but
+    the histogram-exchange probe still runs, resolves auto to a concrete
+    mode, and caches under the shape+mesh key (docs/PERF.md section 5)."""
+    X, y = binary_data
+    cache = tmp_path / "tune.json"
+    bst = lgb.train(dict(PARAMS, autotune=True, tree_learner="data",
+                         autotune_cache=str(cache)),
+                    lgb.Dataset(X, label=y), num_boost_round=2)
+    d = bst._gbdt.autotune_decision
+    assert d is not None
+    assert d["parallel_hist_mode"] in ("allreduce", "reduce_scatter")
+    assert set(d["comm_timings"]) == {"allreduce", "reduce_scatter"}
+    assert d["key"].endswith(f"_mesh{bst._gbdt.n_shards}")
+    assert bst._gbdt.grow_cfg.parallel_hist_mode == d["parallel_hist_mode"]
+    # second construction is a cache hit, not a re-probe
+    bst2 = lgb.train(dict(PARAMS, autotune=True, tree_learner="data",
+                          autotune_cache=str(cache)),
+                     lgb.Dataset(X, label=y), num_boost_round=1)
+    assert bst2._gbdt.autotune_decision.get("cached") in ("memory", "disk")
 
 
 # ---------------------------------------------------------------------------
