@@ -127,9 +127,13 @@ def main() -> None:
     # BENCH_COMM=1: histogram-exchange collective bench, allreduce vs
     # reduce_scatter vs packed (scripts/bench_comm.py, docs/PERF.md
     # section 5); writes BENCH_COMM.json
+    # BENCH_FUSED=1: fused wave megakernel vs two-pass + 4-bit packed
+    # layout sweep (scripts/bench_fused.py, docs/PERF.md section 6);
+    # writes BENCH_FUSED.json
     for env, script in (("BENCH_SERVING", "bench_serving.py"),
                         ("BENCH_ROWWISE", "bench_rowwise.py"),
-                        ("BENCH_COMM", "bench_comm.py")):
+                        ("BENCH_COMM", "bench_comm.py"),
+                        ("BENCH_FUSED", "bench_fused.py")):
         if os.environ.get(env, "") not in ("", "0"):
             import runpy
             runpy.run_path(

@@ -373,6 +373,14 @@ class Config:
     #   rowwise     row-wise multi-value kernel: one launch, per-feature
     #               8-aligned widths into the flat offset buffer
     #               (ops/histogram_rowwise.py, MultiValDenseBin analog)
+    #   rowwise_packed  rowwise + 4-bit storage pack: two <=16-bin
+    #               storage columns per byte, nibble-unpacked in-kernel
+    #               (halves the binned-operand stream; same flat buffer)
+    #   fused       wave megakernel with the split scan fused into the
+    #               histogram epilogue — per-leaf histograms stay VMEM-
+    #               resident, no HBM round-trip before the best-split
+    #               search (ops/grow_fused.py; wave grower only — plain
+    #               histogram builds treat it as "auto")
     # force_row_wise/force_col_wise (the reference's knobs) map onto this:
     # force_row_wise pins rowwise, force_col_wise restricts autotune to
     # the col-wise candidates; setting both is an error.
@@ -427,25 +435,27 @@ class Config:
                 "'basic', 'intermediate'; the reference's 'advanced' "
                 "method is not implemented — see docs/PARITY.md)")
         if self.histogram_impl not in ("auto", "legacy", "tiered",
-                                       "tiered_hilo", "rowwise"):
+                                       "tiered_hilo", "rowwise",
+                                       "rowwise_packed", "fused"):
             log_fatal(
                 f"Unknown histogram_impl '{self.histogram_impl}' "
                 "(supported: 'auto', 'legacy', 'tiered', 'tiered_hilo', "
-                "'rowwise'; see docs/PERF.md)")
+                "'rowwise', 'rowwise_packed', 'fused'; see docs/PERF.md)")
         # the reference rejects the contradictory pair the same way
         # (config.cpp CheckParamConflict)
         if self.force_col_wise and self.force_row_wise:
             log_fatal("Cannot set both force_col_wise and force_row_wise "
                       "to true (pick one histogram layout, or neither "
                       "for the autotuned choice — docs/PERF.md)")
-        if self.force_row_wise and self.histogram_impl not in ("auto",
-                                                               "rowwise"):
+        if self.force_row_wise and self.histogram_impl not in (
+                "auto", "rowwise", "rowwise_packed"):
             log_fatal(
                 f"force_row_wise conflicts with histogram_impl="
                 f"'{self.histogram_impl}' (a col-wise layout); drop one")
-        if self.force_col_wise and self.histogram_impl == "rowwise":
-            log_fatal("force_col_wise conflicts with "
-                      "histogram_impl='rowwise'; drop one")
+        if self.force_col_wise and self.histogram_impl in (
+                "rowwise", "rowwise_packed"):
+            log_fatal("force_col_wise conflicts with histogram_impl="
+                      f"'{self.histogram_impl}'; drop one")
         if self.parallel_hist_mode not in ("auto", "allreduce",
                                            "reduce_scatter"):
             log_fatal(

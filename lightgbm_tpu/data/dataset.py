@@ -140,6 +140,15 @@ class BinnedDataset:
         self.multival_offsets: Optional[List[int]] = None
         self.multival_widths: Optional[List[int]] = None
         self.multival_total: int = 0
+        # 4-bit packed storage (histogram_impl="rowwise_packed",
+        # ops/histogram_rowwise.py Pack4Plan): two <=16-bin storage
+        # columns per byte (lo nibble = earlier column), wider columns
+        # in an unpacked remainder. Built lazily by
+        # `build_multival_packed()`; numpy twin of the device `pack4`.
+        self.X_mv_packed: Optional[np.ndarray] = None  # [N, n_bytes]
+        self.X_mv_rest: Optional[np.ndarray] = None    # [N, n_rest]
+        self.mv_pack_pos: Optional[List[int]] = None   # [F] nibble or -1
+        self.mv_rest_pos: Optional[List[int]] = None   # [F] rest row or -1
 
     # -- derived per-feature arrays consumed by device kernels
     @property
@@ -204,6 +213,27 @@ class BinnedDataset:
         self.X_multival = np.ascontiguousarray(X)
         return self.X_multival
 
+    def build_multival_packed(self):
+        """Build (once) the 4-bit packed twin of the multi-value pack:
+        (packed [N, n_bytes] uint8, rest [N, n_rest] uint8,
+        pack_pos, rest_pos) per `ops/histogram_rowwise.py:Pack4Plan` —
+        packed HOST-SIDE at load time so repeat training streams the
+        halved operand without an on-device repack per histogram call.
+        Returns None when the storage is not 8-bit, the layout has no
+        row-wise plan, or fewer than two columns fit a nibble (packing
+        then saves nothing; the plain rowwise path is strictly better)."""
+        if self.X_mv_packed is not None:
+            return (self.X_mv_packed, self.X_mv_rest,
+                    self.mv_pack_pos, self.mv_rest_pos)
+        if self.build_multival() is None:
+            return None
+        out = _pack4(self.X_multival, self.storage_num_bins())
+        if out is None:
+            return None
+        self.X_mv_packed, self.X_mv_rest, \
+            self.mv_pack_pos, self.mv_rest_pos = out
+        return out
+
     @property
     def label(self) -> Optional[np.ndarray]:
         return self.metadata.label if self.metadata else None
@@ -253,6 +283,42 @@ def _multival_layout(num_bins_seq):
         used += w
     total = col0 + (-(-used // 128) * 128 if used else 0)
     return offsets, widths, total
+
+
+def _pack4(X_multival, num_bins_seq):
+    """4-bit storage pack: numpy-level twin of
+    `ops/histogram_rowwise.py:build_pack4_plan` + `pack4` (duplicated so
+    data loading never imports jax; tests pin the two equal). Columns
+    with <= 16 bins get consecutive nibbles in storage order — byte
+    ``pos // 2``, lo nibble when ``pos`` is even — and wider columns
+    land in the unpacked remainder. Returns (packed [N, n_bytes] uint8,
+    rest [N, n_rest] uint8, pack_pos, rest_pos), or None when fewer
+    than two columns are packable."""
+    pack_pos, rest_pos = [], []
+    np_c, nr = 0, 0
+    for nb in num_bins_seq:
+        if int(nb) <= 16:
+            pack_pos.append(np_c)
+            rest_pos.append(-1)
+            np_c += 1
+        else:
+            pack_pos.append(-1)
+            rest_pos.append(nr)
+            nr += 1
+    if np_c < 2:
+        return None
+    lo_f = [f for f, p in enumerate(pack_pos) if p >= 0 and p % 2 == 0]
+    hi_f = [f for f, p in enumerate(pack_pos) if p >= 0 and p % 2 == 1]
+    rest_f = [f for f, r in enumerate(rest_pos) if r >= 0]
+    N = X_multival.shape[0]
+    lo = X_multival[:, lo_f].astype(np.uint8) & 15
+    hi = X_multival[:, hi_f].astype(np.uint8) & 15
+    if lo.shape[1] > hi.shape[1]:        # odd count: hi nibble stays 0
+        hi = np.pad(hi, ((0, 0), (0, lo.shape[1] - hi.shape[1])))
+    packed = np.ascontiguousarray(lo | (hi << 4))
+    rest = (np.ascontiguousarray(X_multival[:, rest_f]) if rest_f
+            else np.zeros((N, 1), np.uint8))  # dummy row keeps specs legal
+    return packed, rest, pack_pos, rest_pos
 
 
 def _apply_tier_order(ds: BinnedDataset,

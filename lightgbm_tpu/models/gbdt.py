@@ -631,7 +631,8 @@ class GBDT:
                     self.grow_cfg = self.grow_cfg._replace(
                         rows_per_chunk=rc)
                 hist_impl = decision.get("hist_impl")
-                if hist_impl == "rowwise" and cfg.force_col_wise:
+                if hist_impl in ("rowwise", "rowwise_packed") \
+                        and cfg.force_col_wise:
                     # a decision cached by an unconstrained run; the
                     # layout pin outranks it
                     hist_impl = None
@@ -663,7 +664,9 @@ class GBDT:
         Probes a row subsample of the resident binned matrix; skipped on
         meshes (X_t is sharded and the probe would only fence shard 0)."""
         from ..ops.histogram import build_histogram
-        from ..ops.histogram_rowwise import (build_rowwise_plan,
+        from ..ops.histogram_rowwise import (build_pack4_plan,
+                                             build_rowwise_plan,
+                                             pack4_worthwhile,
                                              rowwise_eligible)
         from ..ops.histogram_tiered import build_tier_plan
         if max(self.grow_cfg.hist_tiers) > 256:
@@ -679,6 +682,14 @@ class GBDT:
             "flat_cols": rplan.total,
             "col_wise_cols": sum(c * w for (_, c, w) in plan.classes),
             "chunks": len(rplan.chunks)}
+        pplan = build_pack4_plan(tiers)
+        self.profiler.extras["hist_pack4"] = {
+            "n_packed": pplan.n_packed,
+            "n_rest": pplan.n_rest,
+            # binned-operand stream bytes vs the unpacked storage matrix
+            "stream_frac": round(
+                (((pplan.n_packed + 1) // 2) + max(pplan.n_rest, 1))
+                / max(len(tiers), 1), 4)}
         if self.use_dist:
             return
         n_probe = int(min(self.N_pad, 65536))
@@ -692,6 +703,11 @@ class GBDT:
                 build_histogram(self.X_t[:, :n_probe], vals,
                                 self.num_bins_padded, tiers=tiers,
                                 impl="rowwise")
+            if pack4_worthwhile(pplan):
+                with self._prof_span("hist_rowwise_packed"):
+                    build_histogram(self.X_t[:, :n_probe], vals,
+                                    self.num_bins_padded, tiers=tiers,
+                                    impl="rowwise_packed")
 
     def _comm_iter_profile(self) -> Optional[Dict[str, Any]]:
         """Analytic on-wire byte count of the per-tree histogram exchange

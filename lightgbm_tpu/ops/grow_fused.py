@@ -1,0 +1,320 @@
+"""Fused histogram + best-split-scan wave megakernel.
+
+Extends the wave megakernel (histogram_pallas._wave_kernel: relabel +
+candidate membership + slot histogram) with the cumulative best-split scan
+of ops/split.py run IN the same kernel, on the VMEM-resident flat histogram
+block, before anything is written back to HBM. Per wave this removes the
+full [K, C, F, B] histogram round-trip between the histogram launch and the
+XLA split search — the only [N]-sized traffic left is the row stream the
+grid already double-buffers (each block's X/vals/lor DMA overlaps the
+previous block's compute; Pallas pipelines streamed BlockSpecs
+automatically, docs/PERF.md "Fused wave pass").
+
+The scan epilogue runs once, on the final grid step, and traces the ACTUAL
+search code — split.synth_count_channel and split.find_best_split — on
+values read back out of the output ref:
+
+  * per candidate k the smaller-child histogram is re-assembled from the
+    flat [HB*C*K, Fh*LO] layout by HB*C dynamic row loads (no [K,...]
+    second copy in VMEM),
+  * the parent histogram arrives as a streamed [K, C*F*B] operand held
+    VMEM-resident (constant index map) — the large sibling is
+    parent - small, exactly the subtraction the unfused path does in XLA,
+  * per-child parent scalars (sum_g/sum_h/count/output + smaller_is_left)
+    arrive through SMEM and are picked with dynamic scalar reads,
+  * the 12 SplitResult fields of each of the 2K children land in one
+    [16, RECW] f32 record block via a where-select against a lane iota
+    (select, not multiply-accumulate: a -inf gain times a 0.0 one-hot
+    would poison the lane with NaN).
+
+Because the scan IS the library search traced on identical inputs in
+identical order, the records are bit-identical to the two-pass path by
+construction (tests/test_grow_fused.py). The kernel still emits the full
+histogram block: the grower caches the smaller-child histograms for the
+parent-minus-sibling reuse on the NEXT wave, so the write-back is load-
+bearing, not a debug tap — what the fusion removes is the second read.
+
+Gating (grow_wave.py use_fused): the fused path serves the plain dense
+numerical regime (no quantized gradients, no distribution, no monotone/
+interaction/forced/CEGB constraints, no per-node sampling or extra_trees)
+and is selected via histogram_impl="fused" (config pin or autotune win).
+Everything else falls back to the two-pass megakernel unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..utils import round_up as _round_up
+from .histogram_pallas import (N_BLK, _compute_dims, _feat_chunk,
+                               _hist_chunks, _make_W, _pack_wave_table,
+                               _T_NL0, _unflatten_hist, _wave_logic)
+from .split import (FeatureMeta, SplitHyperParams, find_best_split,
+                    synth_count_channel)
+
+# record block rows (f32; int fields are small exact integers in f32 and
+# are cast back outside) — first 12 rows follow SplitResult field order
+REC_ROWS = 16
+
+
+def rec_width(kmax: int) -> int:
+    """Lane width of the [REC_ROWS, RECW] record block: left children at
+    columns [0, kmax), right children at [kmax, 2*kmax)."""
+    return _round_up(2 * kmax, 128)
+
+
+def pack_fused_meta(num_bins, missing_type, default_bin, is_categorical,
+                    feature_mask=None) -> jnp.ndarray:
+    """[8, 128] i32 per-feature operand for the in-kernel search: rows
+    0..3 are the FeatureMeta arrays, row 4 the column-sampling mask
+    (all-ones when None — find_best_split treats a full mask and None
+    identically)."""
+    F = num_bins.shape[0]
+    m = jnp.zeros((8, 128), jnp.int32)
+    m = m.at[0, :F].set(num_bins.astype(jnp.int32))
+    m = m.at[1, :F].set(missing_type.astype(jnp.int32))
+    m = m.at[2, :F].set(default_bin.astype(jnp.int32))
+    m = m.at[3, :F].set(is_categorical.astype(jnp.int32))
+    fm = (jnp.ones((F,), jnp.int32) if feature_mask is None
+          else feature_mask.astype(jnp.int32))
+    return m.at[4, :F].set(fm)
+
+
+def pack_fused_scalars(bs, smaller_is_left, kmax: int) -> jnp.ndarray:
+    """[8, 2*kmax] f32 SMEM operand: per-child parent statistics in the
+    record column layout (left block then right block). Row 4 carries
+    smaller_is_left duplicated into both halves so the kernel reads it at
+    the child's own column."""
+    sil = smaller_is_left.astype(jnp.float32)
+    rows = [
+        jnp.concatenate([bs.left_sum_g, bs.right_sum_g]),
+        jnp.concatenate([bs.left_sum_h, bs.right_sum_h]),
+        jnp.concatenate([bs.left_count.astype(jnp.float32),
+                         bs.right_count.astype(jnp.float32)]),
+        jnp.concatenate([bs.left_output, bs.right_output]),
+        jnp.concatenate([sil, sil]),
+    ]
+    z = jnp.zeros((2 * kmax,), jnp.float32)
+    return jnp.stack(rows + [z, z, z]).astype(jnp.float32)
+
+
+def _fused_scan(out_ref, parent_ref, scal_ref, meta_ref, rec_ref, *,
+                K, C, LO, HB, F, Fh, B, KMAX, RECW, hp):
+    """Best-split scan over the 2K children of the wave's K candidates,
+    reading the smaller-child histograms straight out of the VMEM-resident
+    out_ref. Runs on the final grid step only."""
+    meta_i = meta_ref[...]                                  # [8, 128] i32
+    meta_k = FeatureMeta(
+        num_bins=meta_i[0, :F],
+        missing_type=meta_i[1, :F],
+        default_bin=meta_i[2, :F],
+        is_categorical=meta_i[3, :F] != 0,
+    )
+    fmask = meta_i[4, :F] != 0
+    lane = jax.lax.broadcasted_iota(jnp.int32, (REC_ROWS, RECW), 1)
+
+    def child(j, carry):
+        k = jnp.where(j < K, j, j - K)
+        is_left = j < K
+        col = jnp.where(is_left, k, KMAX + k)
+        # smaller-child histogram of candidate k from the flat layout
+        # (row hb*C*K + c*K + k holds feature-major LO-wide lo-bins of
+        # hi-block hb, channel c) — HB*C single-row loads, then the same
+        # unflatten _unflatten_hist does outside, minus the K axis
+        rows = [pl.load(out_ref, (pl.ds(hb * C * K + c * K + k, 1),
+                                  slice(None)))
+                for hb in range(HB) for c in range(C)]      # [1, Fh*LO]
+        sm = jnp.concatenate(rows, axis=0).reshape(HB, C, Fh, LO)
+        sm = sm.transpose(1, 2, 0, 3).reshape(C, Fh, HB * LO)[:, :F, :B]
+        par = pl.load(parent_ref, (pl.ds(k, 1), slice(None))) \
+            .reshape(C, F, B)
+        sil = scal_ref[4, col] != 0.0
+        # the left child holds the small histogram iff smaller_is_left
+        use_small = is_left == sil
+        ch = jnp.where(use_small, sm, par - sm)             # [C, F, B]
+        sg = scal_ref[0, col]
+        sh = scal_ref[1, col]
+        cnt = scal_ref[2, col]
+        pout = scal_ref[3, col]
+        hist3 = synth_count_channel(ch, cnt, sh)
+        res = find_best_split(hist3, sg, sh, cnt, pout, meta_k, hp, fmask)
+        f32 = jnp.float32
+        vals = jnp.stack([
+            res.gain.astype(f32),
+            res.feature.astype(f32),
+            res.threshold.astype(f32),
+            res.default_left.astype(f32),
+            res.left_sum_g.astype(f32), res.left_sum_h.astype(f32),
+            res.left_count.astype(f32),
+            res.right_sum_g.astype(f32), res.right_sum_h.astype(f32),
+            res.right_count.astype(f32),
+            res.left_output.astype(f32), res.right_output.astype(f32),
+            jnp.float32(0.0), jnp.float32(0.0),
+            jnp.float32(0.0), jnp.float32(0.0),
+        ])                                                  # [16]
+        return jnp.where(lane == col, vals[:, None], carry)
+
+    rec = jax.lax.fori_loop(0, 2 * K, child,
+                            jnp.zeros((REC_ROWS, RECW), jnp.float32))
+    rec_ref[...] = rec
+
+
+def _fused_wave_kernel(x_ref, v_ref, lor_ref, tbl_ref, parent_ref,
+                       meta_ref, scal_ref, nl0_ref, newlor_ref, out_ref,
+                       rec_ref, *, K, C, LO, HB, F, Fc, Fh, B, KMAX,
+                       RECW, hp, n_blocks):
+    """Grid (N_blocks,). Same streaming body as _wave_kernel, plus the
+    split-scan epilogue on the last step. parent_ref [K, C*F*B] f32,
+    meta_ref [8, 128] i32 and rec_ref [REC_ROWS, RECW] f32 use constant
+    index maps (VMEM-resident across the whole grid); scal_ref
+    [8, 2*KMAX] f32 lives in SMEM for dynamic scalar reads."""
+    n = pl.program_id(0)
+
+    @pl.when(n == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    oh_small = _wave_logic(x_ref, v_ref, lor_ref, tbl_ref, nl0_ref,
+                           newlor_ref, K=K, C=C, F=F, HB=HB,
+                           quantized=False, with_hist=True)
+
+    W = _make_W(v_ref[...], oh_small, C, K, False)
+    xx_all = x_ref[0:F, :].astype(jnp.int32)
+    if HB > 1:
+        xx_all = xx_all & 0xFF
+    _hist_chunks(xx_all, W, out_ref, Fc, C=C, K=K, LO=LO, HB=HB,
+                 quantized=False)
+
+    @pl.when(n == n_blocks - 1)
+    def _():
+        _fused_scan(out_ref, parent_ref, scal_ref, meta_ref, rec_ref,
+                    K=K, C=C, LO=LO, HB=HB, F=F, Fh=Fh, B=B, KMAX=KMAX,
+                    RECW=RECW, hp=hp)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_slots", "num_bins", "kmax", "hp",
+                                    "interpret", "wide_lo"))
+def wave_pass_fused_pallas(
+    X_binned_t: jnp.ndarray,   # [F, N] int8/uint8 (feature-major, F <= 32)
+    vals: jnp.ndarray,         # [C, N] f32 (bag-masked)
+    leaf_of_row: jnp.ndarray,  # [N] int32
+    table: jnp.ndarray,        # [T_ROWS, 128] int32 semantic wave table
+    parent_hist: jnp.ndarray,  # [kmax, C*F*B] f32 candidate parent hists
+    scal: jnp.ndarray,         # [8, 2*kmax] f32 (pack_fused_scalars)
+    meta_ops: jnp.ndarray,     # [8, 128] i32 (pack_fused_meta)
+    num_slots: int,
+    num_bins: int,
+    kmax: int,
+    hp: SplitHyperParams,
+    interpret: bool = False,
+    wide_lo: int = 128,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Single-pass fused wave: returns (new_leaf_of_row [N] i32,
+    hist [K, C, F, num_bins] f32, rec [REC_ROWS, RECW] f32).
+
+    rec columns [0, K) and [kmax, kmax+K) hold the left/right children's
+    SplitResult fields (rows 0..11 in field order); columns of candidates
+    past the wave's bucket K are zero and must be discarded by the
+    caller's validity mask (grow_wave scat does). X/vals may be pre-padded
+    exactly as for wave_pass_pallas."""
+    F, NX = X_binned_t.shape
+    C = vals.shape[0]
+    N = leaf_of_row.shape[0]
+    K = num_slots
+    B_lane, LO, HB = _compute_dims(num_bins, wide_lo)
+    assert F <= 32, "fused wave kernel requires F <= 32 storage columns"
+    assert vals.dtype != jnp.int8, "fused wave kernel is float-mode only"
+    Fp = 32
+    rows = HB * C * K
+    Fc = _feat_chunk(F, LO, rows)
+    Fh = _round_up(F, Fc)
+    RECW = rec_width(kmax)
+    n_blk = N_BLK if NX >= N_BLK else max(_round_up(NX, 256), 256)
+    Np = _round_up(NX, n_blk)
+
+    X = X_binned_t.astype(jnp.int8)
+    if Fp != F or Np != NX:
+        X = jnp.pad(X, ((0, Fp - F), (0, Np - NX)))
+    v = vals.astype(jnp.float32)
+    if v.shape[1] != Np:
+        v = jnp.pad(v, ((0, 0), (0, Np - v.shape[1])))
+    lor = leaf_of_row.astype(jnp.int32)
+    if Np != N:
+        lor = jnp.pad(lor, (0, Np - N), constant_values=-1)
+    tblp = _pack_wave_table(table)
+    nl0 = table[_T_NL0, 0:1].astype(jnp.int32)
+    parent = parent_hist.astype(jnp.float32)[:K]            # [K, C*F*B]
+    CFB = C * F * num_bins
+    assert parent.shape[1] == CFB, (parent.shape, (K, CFB))
+
+    n_blocks = Np // n_blk
+    kernel = functools.partial(_fused_wave_kernel, K=K, C=C, LO=LO, HB=HB,
+                               F=F, Fc=Fc, Fh=Fh, B=num_bins, KMAX=kmax,
+                               RECW=RECW, hp=hp, n_blocks=n_blocks)
+    newlor, out, rec = pl.pallas_call(
+        kernel,
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((Fp, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((128, 8), lambda n: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, CFB), lambda n: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, 128), lambda n: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rows, Fh * LO), lambda n: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((REC_ROWS, RECW), lambda n: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, Np), jnp.int32),
+            jax.ShapeDtypeStruct((rows, Fh * LO), jnp.float32),
+            jax.ShapeDtypeStruct((REC_ROWS, RECW), jnp.float32),
+        ],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            # streamed contraction + one scan's cumsums over 2K children
+            flops=2 * K * C * Fh * Np * B_lane + 2 * K * 3 * F * B_lane * 8,
+            bytes_accessed=Fp * Np + (C * 4 + 8) * Np
+            + rows * Fh * LO * 4 + K * CFB * 4,
+            transcendentals=0,
+        ),
+    )(X, v, lor[None, :], tblp, parent, meta_ops, scal, nl0)
+
+    hist = _unflatten_hist(out, K, C, F, Fh, LO, HB, num_bins)
+    return newlor[0, :N], hist, rec
+
+
+def unpack_fused_records(rec: jnp.ndarray, kmax: int):
+    """[REC_ROWS, RECW] record block -> SplitResult of [2*kmax] arrays
+    (left children at [0, kmax), right at [kmax, 2*kmax)) in exact field
+    order. Integer fields are exact small integers in f32."""
+    from .split import SplitResult
+    r = rec[:, :2 * kmax]
+    return SplitResult(
+        gain=r[0],
+        feature=r[1].astype(jnp.int32),
+        threshold=r[2].astype(jnp.int32),
+        default_left=r[3] > 0.5,
+        left_sum_g=r[4], left_sum_h=r[5], left_count=r[6],
+        right_sum_g=r[7], right_sum_h=r[8], right_count=r[9],
+        left_output=r[10], right_output=r[11],
+    )

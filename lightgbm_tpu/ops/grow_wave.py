@@ -229,7 +229,21 @@ def grow_tree_wave(
     use_mega = (_use_pallas(X_t, B) and not cfg.bundled
                 and not cfg.has_categorical and X_t.shape[0] <= 32
                 and not cfg.feature_parallel
-                and cfg.hist_impl != "rowwise")
+                and cfg.hist_impl not in ("rowwise", "rowwise_packed"))
+    # single-pass fused histogram + split-scan megakernel (grow_fused.py):
+    # selected via histogram_impl="fused" (pin or autotune win) for the
+    # plain dense float regime — every excluded flag below has search-side
+    # state the in-kernel scan does not carry (ops/grow_fused.py docstring)
+    import os as _os
+    use_fused = (use_mega and cfg.hist_impl == "fused"
+                 and not quant and dist is None
+                 and meta.monotone is None and meta.inter_sets is None
+                 and meta.forced is None and meta.cegb_coupled is None
+                 and cfg.cegb_penalty_split <= 0.0
+                 and cfg.feature_fraction_bynode >= 1.0
+                 and not cfg.extra_trees
+                 and _os.environ.get("LIGHTGBM_TPU_DISABLE_FUSED", "")
+                 .lower() not in ("1", "true", "yes"))
     if use_mega:
         # the megakernel's [HB*C*K, 32*LO] f32 output block lives in VMEM
         # for the whole grid; bound K so it stays within scoped VMEM.
@@ -239,6 +253,11 @@ def grow_tree_wave(
         B_lane = _compute_dims(B)[0]
         C_stat = 2          # (grad, hess) in both float and quantized mode
         kcap = 3_400_000 // (C_stat * 32 * B_lane * 4)
+        if use_fused:
+            # the fused kernel additionally holds the [K, C*F*B] parent
+            # histogram operand VMEM-resident for the final-step scan —
+            # same magnitude as the output block, so halve the K cap
+            kcap = kcap // 2
         kcap = max(1 << (kcap.bit_length() - 1), 1) if kcap >= 1 else 1
         buckets = _wave_buckets(L, min(kcap, 128))
         # wide-bin megakernel waves run the hi/lo one-hot decomposition
@@ -246,7 +265,7 @@ def grow_tree_wave(
         # config/autotune pinned the legacy split. VMEM budget is
         # unchanged: HB*LO = B_lane for either choice, so kcap holds.
         mega_wide_lo = 64 if (B_lane > 128 and cfg.hist_impl
-                              in ("auto", "tiered_hilo")) else 128
+                              in ("auto", "tiered_hilo", "fused")) else 128
     else:
         buckets = _wave_buckets(L)
         mega_wide_lo = 128
@@ -946,6 +965,35 @@ def grow_tree_wave(
         mega_branches = [relabel_only_branch] \
             + [make_mega_branch(K) for K in buckets]
 
+    if use_fused:
+        from .grow_fused import (REC_ROWS, pack_fused_meta,
+                                 rec_width, wave_pass_fused_pallas)
+        RECW = rec_width(KMAX)
+        meta_ops_f = pack_fused_meta(meta.num_bins, meta.missing_type,
+                                     meta.default_bin, meta.is_categorical,
+                                     feature_mask)
+
+        def make_fused_branch(K):
+            def branch(args):
+                lor, tbl16, scal, parent_flat = args
+                new_lor, hist, rec = wave_pass_fused_pallas(
+                    X_mega, vals_mega, lor, tbl16, parent_flat, scal,
+                    meta_ops_f, K, B, KMAX, hp, wide_lo=mega_wide_lo)
+                if K < KMAX:
+                    hist = jnp.pad(
+                        hist, ((0, KMAX - K), (0, 0), (0, 0), (0, 0)))
+                return new_lor, hist, rec
+            return branch
+
+        def fused_relabel_branch(args):
+            lor, tbl16, scal, parent_flat = args
+            new_lor = wave_relabel_pallas(X_mega, vals_mega, lor, tbl16, B)
+            return (new_lor, jnp.zeros((KMAX, C, F0, B), hist_dtype),
+                    jnp.zeros((REC_ROWS, RECW), jnp.float32))
+
+        fused_branches = [fused_relabel_branch] \
+            + [make_fused_branch(K) for K in buckets]
+
     # ---- serial ORDER simulation: each step touches only [L]-sized gain/
     # ready arrays (~10 tiny ops), so the 254-step sequential chain costs
     # milliseconds; the heavy per-split state updates happen vectorized in
@@ -1304,8 +1352,27 @@ def grow_tree_wave(
                     jnp.searchsorted(bucket_bounds, n_cand)
                     .astype(jnp.int32), len(buckets) - 1),
                 0)
-            leaf_of_row, hist_wave = jax.lax.switch(
-                kidx_m, mega_branches, (st.leaf_of_row, tbl16))
+            if use_fused:
+                # hoist the per-child parent scalars and the candidate
+                # parent-histogram gather ahead of the kernel: the fused
+                # scan consumes them in VMEM/SMEM on the final grid step.
+                # Record columns of invalid candidates are discarded by
+                # scat's validity mask, so `bs` garbage on padded entries
+                # is harmless — same contract as the vmapped search.
+                from .grow_fused import pack_fused_scalars
+                scal_f = pack_fused_scalars(bs, smaller_is_left, KMAX)
+                parent_flat = jax.lax.cond(
+                    n_cand > 0,
+                    lambda: _onehot_gather(
+                        st.hist_cache, jnp.where(valid, cand, L)),
+                    lambda: jnp.zeros((KMAX, st.hist_cache.shape[1]),
+                                      st.hist_cache.dtype))
+                leaf_of_row, hist_wave, rec_wave = jax.lax.switch(
+                    kidx_m, fused_branches,
+                    (st.leaf_of_row, tbl16, scal_f, parent_flat))
+            else:
+                leaf_of_row, hist_wave = jax.lax.switch(
+                    kidx_m, mega_branches, (st.leaf_of_row, tbl16))
             st = st._replace(leaf_of_row=leaf_of_row)
             slot_small = None
         elif use_apply:
@@ -1398,9 +1465,15 @@ def grow_tree_wave(
                 hist_small = hist_local
             else:
                 hist_small = exchange_hist(hist_local, psum, 1)
-            hist_parent = _onehot_gather(
-                st.hist_cache, jnp.where(valid, cand, L)
-            ).reshape((KMAX,) + hshape)                      # [K, C, F, B]
+            if use_fused:
+                # the same gather already ran for the kernel's scan
+                # operand — reuse it (XLA CSE would anyway; this keeps
+                # the dependency explicit)
+                hist_parent = parent_flat.reshape((KMAX,) + hshape)
+            else:
+                hist_parent = _onehot_gather(
+                    st.hist_cache, jnp.where(valid, cand, L)
+                ).reshape((KMAX,) + hshape)                  # [K, C, F, B]
             hist_large = hist_parent - hist_small
             hist_l = jnp.where(smaller_is_left[:, None, None, None],
                                hist_small, hist_large)
@@ -1471,6 +1544,19 @@ def grow_tree_wave(
                 fidl_k = fidr_k = jnp.full((KMAX,), -1, jnp.int32)
                 fid_lr = None
             n_batch = (3 if research_own else 2) * KMAX
+            if use_fused:
+                # the kernel's final-step scan already searched both
+                # children of every candidate on the identical histogram
+                # values (ops/grow_fused.py) — unpack its record block
+                # instead of re-running the vmapped search. hist_lr and
+                # friends above become dead code XLA eliminates; only
+                # hist_small (the next wave's subtraction cache) and the
+                # scalar concatenations survive.
+                from .grow_fused import unpack_fused_records
+                s_lr = unpack_fused_records(rec_wave, KMAX)
+                cat_lr = jnp.zeros((2 * KMAX,), bool)
+                bits_lr = jnp.zeros((2 * KMAX, W), jnp.uint32)
+                forced_lr = jnp.zeros((2 * KMAX,), bool)
             if bynode:
                 bn_masks = node_masks(
                     jax.random.fold_in(_bn_base,
@@ -1568,7 +1654,7 @@ def grow_tree_wave(
                 # voted-local feature index -> global feature id
                 s_lr = s_lr._replace(feature=jnp.take_along_axis(
                     vf, s_lr.feature[:, None], axis=1)[:, 0])
-            else:
+            elif not use_fused:
                 xt_rand = (xt_bins(
                     jax.random.fold_in(_xt_base, st.tree.num_waves + 1),
                     n_batch) if xt else None)

@@ -59,8 +59,8 @@ def _tier_route(tiers, F: int, num_bins: int, impl: str):
 
     `tiers` is the per-STORAGE-COLUMN bin count tuple in storage order
     (GrowConfig.hist_tiers); `impl` is one of "auto" / "legacy" /
-    "tiered" / "tiered_hilo" / "rowwise" (config.histogram_impl,
-    possibly overridden by runtime/autotune.py).
+    "tiered" / "tiered_hilo" / "rowwise" / "rowwise_packed" / "fused"
+    (config.histogram_impl, possibly overridden by runtime/autotune.py).
 
     Returns None (uniform legacy kernel, caller's num_bins), or
     ("legacy", eff_bins, wide_lo) — single width class: one kernel
@@ -69,18 +69,31 @@ def _tier_route(tiers, F: int, num_bins: int, impl: str):
     ("tiered", plan, hilo) for the multi-class flat-offset path, or
     ("rowwise", rplan) for the row-wise multi-value path
     (histogram_rowwise.py; the caller still checks `rowwise_eligible`
-    against its C*K output size and falls back to the col-wise route).
+    against its C*K output size and falls back to the col-wise route),
+    or ("rowwise_packed", rplan, pplan) for its 4-bit packed variant
+    (falls back to plain rowwise when fewer than two columns fit a
+    nibble). "fused" names the wave grower's fused megakernel
+    (ops/grow_fused.py) — it has no plain-histogram form, so here it
+    routes like "auto".
 
     The `len(tiers) != F` guard keeps callers that slice the feature
     axis (feature-parallel shards, compile-warm dummy calls) on the
     legacy kernel rather than mis-applying a full-width plan."""
+    if impl == "fused":
+        impl = "auto"
     if impl == "legacy" or not tiers or len(tiers) != F \
             or max(tiers) > 256:
         return None
-    if impl == "rowwise":
-        from .histogram_rowwise import build_rowwise_plan
-        return ("rowwise",
-                build_rowwise_plan(tuple(int(t) for t in tiers)))
+    if impl in ("rowwise", "rowwise_packed"):
+        from .histogram_rowwise import (build_pack4_plan,
+                                        build_rowwise_plan,
+                                        pack4_worthwhile)
+        rplan = build_rowwise_plan(tuple(int(t) for t in tiers))
+        if impl == "rowwise_packed":
+            pplan = build_pack4_plan(tuple(int(t) for t in tiers))
+            if pack4_worthwhile(pplan):
+                return ("rowwise_packed", rplan, pplan)
+        return ("rowwise", rplan)
     from .histogram_tiered import build_tier_plan, class_wide_lo
     plan = build_tier_plan(tuple(int(t) for t in tiers))
     hilo = impl in ("auto", "tiered_hilo")
@@ -111,10 +124,16 @@ def build_histogram(
     if _use_pallas(X_binned_t, num_bins):
         from .histogram_pallas import build_histogram_pallas
         route = _tier_route(tiers, X_binned_t.shape[0], num_bins, impl)
-        if route is not None and route[0] == "rowwise":
-            from .histogram_rowwise import (build_histogram_rowwise,
-                                            rowwise_eligible)
+        if route is not None and route[0] in ("rowwise", "rowwise_packed"):
+            from .histogram_rowwise import (
+                build_histogram_rowwise, build_histogram_slots_rowwise_packed,
+                rowwise_eligible)
             if rowwise_eligible(route[1], vals.shape[0], 1):
+                if route[0] == "rowwise_packed":
+                    slot0 = jnp.zeros((X_binned_t.shape[1],), jnp.int32)
+                    return build_histogram_slots_rowwise_packed(
+                        X_binned_t, vals, slot0, 1, num_bins,
+                        route[1], route[2])[0]
                 return build_histogram_rowwise(X_binned_t, vals, num_bins,
                                                route[1])
             # flat output exceeds the VMEM residency budget: col-wise
@@ -156,10 +175,15 @@ def build_histogram_slots(
     if _use_pallas(X_binned_t, num_bins):
         from .histogram_pallas import build_histogram_slots_pallas
         route = _tier_route(tiers, X_binned_t.shape[0], num_bins, impl)
-        if route is not None and route[0] == "rowwise":
-            from .histogram_rowwise import (build_histogram_slots_rowwise,
-                                            rowwise_eligible)
+        if route is not None and route[0] in ("rowwise", "rowwise_packed"):
+            from .histogram_rowwise import (
+                build_histogram_slots_rowwise,
+                build_histogram_slots_rowwise_packed, rowwise_eligible)
             if rowwise_eligible(route[1], vals.shape[0], num_slots):
+                if route[0] == "rowwise_packed":
+                    return build_histogram_slots_rowwise_packed(
+                        X_binned_t, vals, slot, num_slots, num_bins,
+                        route[1], route[2])
                 return build_histogram_slots_rowwise(
                     X_binned_t, vals, slot, num_slots, num_bins, route[1])
             # wide wave: flat output exceeds the VMEM residency budget

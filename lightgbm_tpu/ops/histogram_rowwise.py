@@ -123,31 +123,16 @@ def rowwise_eligible(plan: RowWisePlan, C: int, K: int) -> bool:
     return plan.total > 0 and C * K * plan.total * 4 <= OUT_VMEM_BYTES
 
 
-def _rowwise_kernel(x_ref, v_ref, s_ref, out_ref, *, K, C, chunks,
-                    quantized):
-    """Grid (N_blocks,): the flat [C*K, total] output block is resident
-    across the whole row sweep.
-
-    x_ref  [F, R]   int8        binned storage columns (this row block)
-    v_ref  [C, R]   f32 / int8  value channels (bag-masked)
-    s_ref  [1, R]   int32       slot id per row; outside [0, K) = none
-    out_ref[C*K, total]         f32 / int32 flat per-feature-offset buffer
-    """
-    n = pl.program_id(0)
-
-    @pl.when(n == 0)
-    def _():
-        out_ref[...] = jnp.zeros_like(out_ref)
-
-    R = v_ref.shape[1]
+def _mv_accum(xx_all, W, out_ref, *, chunks, quantized):
+    """Shared multi-value contraction body: one MXU matmul per column
+    chunk, accumulating into the VMEM-resident flat buffer. `xx_all`
+    is the [F, R] int32 bin-code block — materialized from the plain
+    int8 storage OR nibble-unpacked from the 4-bit pack; either way the
+    codes (and thus every one-hot product) are identical, which is what
+    makes the packed kernel bit-identical by construction."""
+    R = xx_all.shape[1]
     w_dtype = jnp.int8 if quantized else jnp.bfloat16
     acc = jnp.int32 if quantized else jnp.float32
-    iota_k = jax.lax.broadcasted_iota(jnp.int32, (K, R), 0)
-    oh_slot = s_ref[0:1, :] == iota_k                   # [K, R]
-    W = _make_W(v_ref[...], oh_slot, C, K, quantized)   # [C*K, R]
-    # storage rides in as int8 (Mosaic-safe narrow load); mask the sign
-    # extension away so 256-bin columns compare as unsigned 0..255
-    xx_all = x_ref[...].astype(jnp.int32) & 255
     for (col0, cols, runs) in chunks:
         # concatenated multi-value one-hot: run (f0, m, w) owns sublanes
         # [off, off + m*w) with oh[off + j*w + b, r] = (bin[f0+j, r] == b)
@@ -166,6 +151,32 @@ def _rowwise_kernel(x_ref, v_ref, s_ref, out_ref, *, K, C, chunks,
             W, oh, (((1,), (1,)), ((), ())),
             preferred_element_type=acc)                 # [C*K, cols]
         out_ref[:, col0:col0 + cols] += part
+
+
+def _rowwise_kernel(x_ref, v_ref, s_ref, out_ref, *, K, C, chunks,
+                    quantized):
+    """Grid (N_blocks,): the flat [C*K, total] output block is resident
+    across the whole row sweep.
+
+    x_ref  [F, R]   int8        binned storage columns (this row block)
+    v_ref  [C, R]   f32 / int8  value channels (bag-masked)
+    s_ref  [1, R]   int32       slot id per row; outside [0, K) = none
+    out_ref[C*K, total]         f32 / int32 flat per-feature-offset buffer
+    """
+    n = pl.program_id(0)
+
+    @pl.when(n == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    R = v_ref.shape[1]
+    iota_k = jax.lax.broadcasted_iota(jnp.int32, (K, R), 0)
+    oh_slot = s_ref[0:1, :] == iota_k                   # [K, R]
+    W = _make_W(v_ref[...], oh_slot, C, K, quantized)   # [C*K, R]
+    # storage rides in as int8 (Mosaic-safe narrow load); mask the sign
+    # extension away so 256-bin columns compare as unsigned 0..255
+    xx_all = x_ref[...].astype(jnp.int32) & 255
+    _mv_accum(xx_all, W, out_ref, chunks=chunks, quantized=quantized)
 
 
 @functools.partial(jax.jit, static_argnames=("num_slots", "plan",
@@ -256,6 +267,214 @@ def build_histogram_rowwise(
                                         num_bins, plan,
                                         interpret=interpret)
     return out[0]
+
+
+# ---------------------------------------------------------------------------
+# 4-bit packed storage (histogram_impl="rowwise_packed", docs/PERF.md)
+#
+# dense_wide / sparse_onehot shapes are dominated by many narrow columns
+# (one-hot expansions bin to 2-3 bins; EFB bundles of them stay under 16)
+# whose int8 storage wastes half its bits. Pack TWO <=16-bin storage
+# columns per byte — lo nibble = earlier column, hi nibble = later — so
+# the binned operand streams at half the HBM bytes, and nibble-unpack
+# in-kernel (two VPU shifts/masks) before the SAME `_mv_accum` one-hot
+# contraction feeds the MXU. Codes after unpack are identical to the
+# unpacked kernel's, so the flat buffer is bit-identical by construction.
+# Columns wider than 16 bins ride in an unpacked remainder operand.
+
+class Pack4Plan(NamedTuple):
+    """Static nibble layout (hashable — jit static arg / lru key).
+
+    ``pack_pos[f]``: nibble index of storage column f among the packed
+    columns (byte ``pack_pos[f] // 2``, shift ``4 * (pack_pos[f] % 2)``),
+    or -1 when the column is too wide and lives in the remainder at row
+    ``rest_pos[f]``. An odd packed count leaves the last byte's hi
+    nibble zero — no ``pack_pos`` points at it, so it is never read."""
+    pack_pos: tuple   # [F] nibble index among packed columns, or -1
+    rest_pos: tuple   # [F] row in the unpacked remainder, or -1
+    n_packed: int     # packable columns (num_bins <= 16)
+    n_rest: int       # remainder columns
+
+
+@functools.lru_cache(maxsize=256)
+def build_pack4_plan(feature_num_bins: tuple) -> Pack4Plan:
+    """Assign every <=16-bin storage column a nibble, in storage order
+    (numpy twin: `data/dataset.py:_pack4` packs host-side from the same
+    rule; tests pin the two equal)."""
+    pack_pos, rest_pos = [], []
+    np_, nr = 0, 0
+    for nb in feature_num_bins:
+        if int(nb) <= 16:
+            pack_pos.append(np_)
+            rest_pos.append(-1)
+            np_ += 1
+        else:
+            pack_pos.append(-1)
+            rest_pos.append(nr)
+            nr += 1
+    return Pack4Plan(tuple(pack_pos), tuple(rest_pos), np_, nr)
+
+
+def pack4_worthwhile(pplan: Pack4Plan) -> bool:
+    """Packing saves bytes only when at least one byte carries two
+    columns; below that the dispatcher stays on the plain rowwise path."""
+    return pplan.n_packed >= 2
+
+
+def pack4(X_binned_t: jnp.ndarray, pplan: Pack4Plan):
+    """Device-side pack: [F, N] int8 storage -> (Xp [n_bytes, N] int8,
+    Xu [max(n_rest, 1), N] int8). One elementwise pass; datasets that
+    train repeatedly should pack ONCE and reuse (the kernel entry
+    accepts prepacked operands) — see `data/dataset.py:_pack4` for the
+    host-side twin that packs at load time."""
+    import numpy as np
+    F, N = X_binned_t.shape
+    assert len(pplan.pack_pos) == F
+    lo_f = [f for f in range(F) if pplan.pack_pos[f] >= 0
+            and pplan.pack_pos[f] % 2 == 0]
+    hi_f = [f for f in range(F) if pplan.pack_pos[f] >= 0
+            and pplan.pack_pos[f] % 2 == 1]
+    rest_f = [f for f in range(F) if pplan.rest_pos[f] >= 0]
+    xi = X_binned_t.astype(jnp.int32) & 15
+    lo = xi[np.asarray(lo_f, np.int32), :] if lo_f \
+        else jnp.zeros((0, N), jnp.int32)
+    hi = xi[np.asarray(hi_f, np.int32), :] if hi_f \
+        else jnp.zeros((0, N), jnp.int32)
+    if lo.shape[0] > hi.shape[0]:        # odd count: hi nibble stays 0
+        hi = jnp.pad(hi, ((0, lo.shape[0] - hi.shape[0]), (0, 0)))
+    Xp = (lo | (hi << 4)).astype(jnp.int8)
+    if rest_f:
+        Xu = X_binned_t[np.asarray(rest_f, np.int32), :].astype(jnp.int8)
+    else:                                # dummy row keeps BlockSpecs legal
+        Xu = jnp.zeros((1, N), jnp.int8)
+    return Xp, Xu
+
+
+def _unpack4_rows(xp, xu, pack_pos, rest_pos):
+    """Reassemble the [F, R] int32 bin-code block in STORAGE order from
+    the packed nibbles + remainder — static slices only (Mosaic-safe).
+    Feeding the result to `_mv_accum` makes the packed kernel's flat
+    buffer bit-identical to the unpacked kernel's."""
+    xpi = xp.astype(jnp.int32) & 255
+    xui = xu.astype(jnp.int32) & 255
+    rows = []
+    for f in range(len(pack_pos)):
+        p = pack_pos[f]
+        if p >= 0:
+            rows.append((xpi[p // 2:p // 2 + 1, :] >> (4 * (p % 2))) & 15)
+        else:
+            r = rest_pos[f]
+            rows.append(xui[r:r + 1, :])
+    return jnp.concatenate(rows, axis=0) if len(rows) > 1 else rows[0]
+
+
+def _rowwise_packed_kernel(xp_ref, xu_ref, v_ref, s_ref, out_ref, *, K, C,
+                           chunks, pack_pos, rest_pos, quantized):
+    """`_rowwise_kernel` with the binned operand split into 4-bit packed
+    bytes + unpacked remainder; identical contraction body."""
+    n = pl.program_id(0)
+
+    @pl.when(n == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    R = v_ref.shape[1]
+    iota_k = jax.lax.broadcasted_iota(jnp.int32, (K, R), 0)
+    oh_slot = s_ref[0:1, :] == iota_k
+    W = _make_W(v_ref[...], oh_slot, C, K, quantized)
+    xx_all = _unpack4_rows(xp_ref[...], xu_ref[...], pack_pos, rest_pos)
+    _mv_accum(xx_all, W, out_ref, chunks=chunks, quantized=quantized)
+
+
+@functools.partial(jax.jit, static_argnames=("num_slots", "plan", "pplan",
+                                             "interpret"))
+def build_histogram_slots_rowwise_packed_flat(
+    Xp: jnp.ndarray,           # [n_bytes, N] int8: two nibble columns/byte
+    Xu: jnp.ndarray,           # [max(n_rest, 1), N] int8 remainder
+    vals: jnp.ndarray,         # [C, N] f32 (bag-masked) or int8 (quantized)
+    slot: jnp.ndarray,         # [N] int32
+    num_slots: int,
+    plan: RowWisePlan,
+    pplan: Pack4Plan,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Flat row-wise wave histogram from PREPACKED operands: returns
+    [K, C, total] like `build_histogram_slots_rowwise_flat`, streaming
+    half the binned bytes for the packed columns."""
+    N = Xp.shape[1]
+    C = vals.shape[0]
+    K = num_slots
+    F = len(plan.widths)
+    assert len(pplan.pack_pos) == F
+    assert pplan.n_packed >= 1, "no packable columns: use the plain path"
+    assert Xp.shape[0] == (pplan.n_packed + 1) // 2
+    quantized = vals.dtype == jnp.int8
+    rows = C * K
+    n_blk = N_BLK if N >= N_BLK else max(_round_up(N, 256), 256)
+    Np = _round_up(N, n_blk)
+    Xp = Xp.astype(jnp.int8)
+    Xu = Xu.astype(jnp.int8)
+    v = vals if quantized else vals.astype(jnp.float32)
+    s = slot.astype(jnp.int32)
+    if Np != N:
+        Xp = jnp.pad(Xp, ((0, 0), (0, Np - N)))
+        Xu = jnp.pad(Xu, ((0, 0), (0, Np - N)))
+        v = jnp.pad(v, ((0, 0), (0, Np - N)))
+        s = jnp.pad(s, (0, Np - N), constant_values=-1)
+    out_dtype = jnp.int32 if quantized else jnp.float32
+    FP, FU = Xp.shape[0], Xu.shape[0]
+    kernel = functools.partial(_rowwise_packed_kernel, K=K, C=C,
+                               chunks=plan.chunks,
+                               pack_pos=pplan.pack_pos,
+                               rest_pos=pplan.rest_pos,
+                               quantized=quantized)
+    out = pl.pallas_call(
+        kernel,
+        grid=(Np // n_blk,),
+        in_specs=[
+            pl.BlockSpec((FP, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((FU, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n_blk), lambda n: (0, n),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((rows, plan.total), lambda n: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, plan.total), out_dtype),
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=2 * rows * plan.total * Np,
+            bytes_accessed=(FP + FU) * Np + (C * 4 + 4) * Np
+            + rows * plan.total * 4,
+            transcendentals=0,
+        ),
+    )(Xp, Xu, v, s[None, :])
+    return out.reshape(C, K, plan.total).transpose(1, 0, 2)
+
+
+def build_histogram_slots_rowwise_packed(
+    X_binned_t: jnp.ndarray,
+    vals: jnp.ndarray,
+    slot: jnp.ndarray,
+    num_slots: int,
+    num_bins: int,
+    plan: RowWisePlan,
+    pplan: Pack4Plan,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Packed row-wise wave histogram expanded back to the uniform grid
+    [K, C, F, num_bins] — packs on the fly (correctness/dispatch path;
+    benchmarks and repeat-train callers prepack via `pack4` once and
+    call the `_flat` entry directly)."""
+    from .split import expand_feature_offset_hist
+    Xp, Xu = pack4(X_binned_t, pplan)
+    flat = build_histogram_slots_rowwise_packed_flat(
+        Xp, Xu, vals, slot, num_slots, plan, pplan, interpret=interpret)
+    return expand_feature_offset_hist(flat, plan.offsets, plan.widths,
+                                      num_bins)
 
 
 def _build_histogram_slots_rowwise_xla(X_binned_t, vals, slot, num_slots,

@@ -56,10 +56,16 @@ COMM_MODE_PREFERENCE = ("reduce_scatter", "allreduce")
 
 # histogram implementation candidates (ops/histogram.py _tier_route,
 # docs/PERF.md); tie preference matches the "auto" default so a tie
-# reproduces untuned behavior — the row-wise layout probes last and must
+# reproduces untuned behavior — the row-wise layouts probe last and must
 # win outright (the TrainingShareStates col-vs-row timing dance,
-# train_share_states.cpp InitTrain)
-HIST_IMPL_CANDIDATES = ("tiered_hilo", "tiered", "legacy", "rowwise")
+# train_share_states.cpp InitTrain). "rowwise_packed" is the 4-bit
+# nibble pack (histogram_rowwise.py Pack4Plan); its probe silently runs
+# plain rowwise when nothing is packable, so it never wins a tie.
+# "fused" (the wave megakernel with the in-kernel split scan,
+# ops/grow_fused.py) is NOT in this list: it has no plain-histogram
+# form, so `probe_fused_wave` times it as a whole wave pass instead.
+HIST_IMPL_CANDIDATES = ("tiered_hilo", "tiered", "legacy", "rowwise",
+                        "rowwise_packed")
 # force_col_wise restricts the probe to these (models/gbdt.py)
 COL_WISE_HIST_IMPLS = ("tiered_hilo", "tiered", "legacy")
 
@@ -282,6 +288,119 @@ def probe_hist_impls(X_t, cfg, impl_candidates: Sequence[str]
     return timings
 
 
+def probe_fused_wave(X_t, cfg, probe_rows: int = DEFAULT_PROBE_ROWS,
+                     seed: int = 0,
+                     timer: Callable[[], float] = time.perf_counter,
+                     ) -> Dict[str, float]:
+    """Time one synthetic wave step both ways: the two-pass shape
+    (``wave_pass_pallas`` then the XLA split search over every child)
+    vs the single-launch fused megakernel with the in-kernel scan
+    (``ops/grow_fused.py:wave_pass_fused_pallas``). ``histogram_impl=
+    "fused"`` has no plain-histogram form, so it cannot ride
+    ``probe_hist_impls`` — this is its timing probe, cached in the same
+    decision. Returns ``{"two_pass": s, "fused": s}``; either side
+    failing (non-TPU backend, >32 features, wide bins) drops its key and
+    the caller keeps the unfused wave."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops.grow_fused import (pack_fused_meta, pack_fused_scalars,
+                                  wave_pass_fused_pallas)
+    from ..ops.histogram_pallas import T_ROWS, wave_pass_pallas
+    from ..ops.split import (FeatureMeta, SplitHyperParams, find_best_split,
+                             synth_count_channel)
+    from .profiler import device_barrier
+
+    F_all, n = int(X_t.shape[0]), int(X_t.shape[1])
+    F = min(F_all, 32)
+    B = int(cfg.num_bins_padded)
+    if B > 256:
+        return {}
+    m = max(min(int(probe_rows), n), 1)
+    Xs = jnp.asarray(jax.device_get(X_t[:F, :m]))
+    rng = np.random.RandomState(seed)
+    vals = jnp.asarray(
+        rng.uniform(-0.5, 0.5, size=(2, m)).astype(np.float32))
+    K, KMAX = 4, 8
+    lor = jnp.asarray(rng.randint(0, K, size=m).astype(np.int32))
+    tiers = tuple(int(t) for t in cfg.hist_tiers[:F])
+    nb = np.clip(np.asarray(tiers + (B,) * (F - len(tiers)), np.int32),
+                 2, B)
+
+    # synthetic wave table: K candidate leaves splitting feature 0 at the
+    # mid bin, no applied entries (relabel work is identical either way)
+    tbl = np.full((T_ROWS, 128), -1, np.int32)
+    tbl[7, :K] = np.arange(K)                  # cand leaf ids
+    tbl[8, :K] = 0                             # cand feature
+    tbl[9, :K] = max(int(nb[0]) // 2 - 1, 0)   # cand threshold
+    tbl[10, :K] = 1                            # default_left
+    tbl[11, :K] = 0                            # missing none
+    tbl[12, :K] = 0
+    tbl[13, :K] = nb[0]
+    tbl[14, :K] = 1                            # smaller_is_left
+    tbl[15, :K] = K                            # first new leaf id
+    tbl16 = jnp.asarray(tbl)
+
+    hp = SplitHyperParams(20.0, 1e-3, 0.0, 0.0, 0.0, 0.0, 0.0)
+    meta = FeatureMeta(num_bins=jnp.asarray(nb),
+                       missing_type=jnp.zeros((F,), jnp.int32),
+                       default_bin=jnp.zeros((F,), jnp.int32),
+                       is_categorical=jnp.zeros((F,), bool))
+    fmask = jnp.ones((F,), bool)
+    parent = jnp.full((KMAX, 2, F, B), float(m), jnp.float32)
+
+    class _BS:
+        left_sum_g = jnp.zeros((KMAX,), jnp.float32)
+        left_sum_h = jnp.full((KMAX,), float(m) * 0.25, jnp.float32)
+        left_count = jnp.full((KMAX,), float(m) // K, jnp.float32)
+        left_output = jnp.zeros((KMAX,), jnp.float32)
+        right_sum_g = jnp.zeros((KMAX,), jnp.float32)
+        right_sum_h = jnp.full((KMAX,), float(m) * 0.25, jnp.float32)
+        right_count = jnp.full((KMAX,), float(m) // K, jnp.float32)
+        right_output = jnp.zeros((KMAX,), jnp.float32)
+
+    sil = jnp.ones((KMAX,), jnp.float32)
+    scal = pack_fused_scalars(_BS, sil, KMAX)
+    meta_ops = pack_fused_meta(meta.num_bins, meta.missing_type,
+                               meta.default_bin, meta.is_categorical)
+
+    def two_pass(X, v, l0):
+        new_lor, hist = wave_pass_pallas(X, v, l0, tbl16, K, B)
+        hist = jnp.pad(hist, ((0, KMAX - K), (0, 0), (0, 0), (0, 0)))
+        hs = jnp.concatenate([hist, parent - hist], axis=0)  # [2*KMAX,...]
+        h3 = jax.vmap(lambda hh, c, s: synth_count_channel(hh, c, s))(
+            hs, jnp.tile(_BS.left_count, 2), jnp.tile(_BS.left_sum_h, 2))
+        res = jax.vmap(lambda hh, sg, sh, c, o: find_best_split(
+            hh, sg, sh, c, o, meta, hp, fmask))(
+            h3, jnp.tile(_BS.left_sum_g, 2), jnp.tile(_BS.left_sum_h, 2),
+            jnp.tile(_BS.left_count, 2), jnp.tile(_BS.left_output, 2))
+        return new_lor, hist, res.gain
+
+    def fused(X, v, l0):
+        return wave_pass_fused_pallas(X, v, l0, tbl16,
+                                      parent.reshape(KMAX, -1), scal,
+                                      meta_ops, K, B, KMAX, hp)
+
+    timings: Dict[str, float] = {}
+    for name, fn in (("two_pass", two_pass), ("fused", fused)):
+        try:
+            jitted = jax.jit(fn)
+            _block(jitted(Xs, vals, lor))
+            best = float("inf")
+            for _ in range(2):
+                device_barrier()
+                t0 = timer()
+                _block(jitted(Xs, vals, lor))
+                best = min(best, timer() - t0)
+            timings[name] = best
+        except Exception as e:                    # noqa: BLE001
+            from ..utils.log import log_warning
+            log_warning(f"autotune: fused-wave probe '{name}' failed "
+                        f"({type(e).__name__}); dropping candidate")
+    return timings
+
+
 def probe_comm_modes(mesh, n_features: int, num_bins_padded: int,
                      channels: int = 3, seed: int = 0,
                      timer: Callable[[], float] = time.perf_counter,
@@ -412,15 +531,18 @@ def autotune_decision(X_t, meta, cfg, candidates: Sequence[str], *,
     COL_WISE_HIST_IMPLS under force_col_wise); None = all candidates.
     """
     impl_cands = tuple(hist_impl_candidates or HIST_IMPL_CANDIDATES)
+    # "fused" never rides the plain-histogram probe list but is a valid
+    # cached outcome of the fused-wave probe below
+    impl_ok = (None, "fused", *impl_cands)
     key = make_key(n_rows, n_features, max_bin, num_leaves)
     if key in _MEM_CACHE \
-            and _MEM_CACHE[key].get("hist_impl") in (None, *impl_cands):
+            and _MEM_CACHE[key].get("hist_impl") in impl_ok:
         return dict(_MEM_CACHE[key], cached="memory")
     path = cache_path or default_cache_path()
     disk = load_disk_cache(path)
     hit = disk.get(key)
     if isinstance(hit, dict) and hit.get("grower") in (None, *candidates) \
-            and hit.get("hist_impl") in (None, *impl_cands):
+            and hit.get("hist_impl") in impl_ok:
         _MEM_CACHE[key] = hit
         return dict(hit, cached="disk")
 
@@ -453,6 +575,21 @@ def autotune_decision(X_t, meta, cfg, candidates: Sequence[str], *,
             probe_rows=probe_rows, seed=seed, timer=timer)
         hist_impl = _pick_winner(hist_impl_timings, HIST_IMPL_CANDIDATES)
 
+    # fused wave megakernel (ops/grow_fused.py): only reachable when the
+    # wave grower won and the layout choice is open; must beat the
+    # two-pass wave OUTRIGHT (a tie keeps the well-trodden unfused path)
+    fused_timings: Dict[str, float] = {}
+    if getattr(cfg, "hist_impl", "auto") == "auto" \
+            and getattr(cfg, "hist_tiers", ()) \
+            and winner in ("wave", "wave_exact") \
+            and hist_impl not in ("rowwise", "rowwise_packed"):
+        fused_timings = probe_fused_wave(X_t, cfg, probe_rows=probe_rows,
+                                         seed=seed, timer=timer)
+        if "fused" in fused_timings and "two_pass" in fused_timings \
+                and fused_timings["fused"] \
+                < fused_timings["two_pass"] * (1.0 - TIE_TOL):
+            hist_impl = "fused"
+
     decision: Dict[str, Any] = {
         "grower": winner,
         "rows_per_chunk": rows_per_chunk,
@@ -462,6 +599,8 @@ def autotune_decision(X_t, meta, cfg, candidates: Sequence[str], *,
                           for k, v in chunk_timings.items()},
         "hist_impl_timings": {k: round(v, 6)
                               for k, v in hist_impl_timings.items()},
+        "fused_wave_timings": {k: round(v, 6)
+                               for k, v in fused_timings.items()},
         "key": key,
         "probe_rows": min(int(probe_rows), int(X_t.shape[1])),
     }

@@ -1,0 +1,276 @@
+"""Fused wave megakernel + 4-bit packed layout bench (docs/PERF.md
+section 6).
+
+Two sweeps, one JSON line (also runnable via ``BENCH_FUSED=1 python
+bench.py``; redirect to BENCH_FUSED.json to refresh the committed
+artifact checked by scripts/check_stale_claims.py):
+
+* ``wave`` — one synthetic wave step (the autotuner's
+  ``probe_fused_wave`` shape: K=4 candidate leaves, KMAX=8, F=28) at
+  63 and 255 bins, timed two ways: the two-pass path (histogram pass,
+  then the XLA split search over every child) vs the single-launch
+  fused megakernel (``ops/grow_fused.py``) whose scan runs in the
+  kernel epilogue on the VMEM-resident accumulators. On a TPU the
+  two-pass arm is the real ``wave_pass_pallas``; elsewhere it is the
+  exact XLA histogram lowering the production CPU path dispatches to
+  (the fused kernel is TPU-only, so off-TPU the record carries the
+  kernel-true two-pass reference rate and a small interpret-mode
+  bitwise parity check instead of a fused timing).
+
+* ``pack4`` — the row-wise multi-value layout with and without the
+  4-bit packing (``histogram_impl=rowwise_packed``) on the
+  BENCH_ROWWISE.json deficit shapes (``sparse_onehot``, plus a
+  nibble-wide ``dense_nibble``) and the unpackable ``dense_wide``
+  control. Off-TPU the packed kernel has no XLA twin, so the arm
+  records interpret-mode bitwise parity rather than a rate; the
+  ``device`` field says which kind of numbers you are looking at.
+
+Env knobs: FUSED_ROWS (default 120000), FUSED_REPS (3),
+FUSED_SLOTS (pack4 sweep wave width, default 8).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _time_best(fn, args, reps):
+    import jax
+    jitted = jax.jit(fn)
+    jax.block_until_ready(jitted(*args))      # compile
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(jitted(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _wave_sweep(rows, reps, on_tpu):
+    """Synthetic-wave two_pass vs fused at 63- and 255-bin widths."""
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.grow_fused import (pack_fused_meta,
+                                             pack_fused_scalars,
+                                             wave_pass_fused_pallas)
+    from lightgbm_tpu.ops.histogram import _build_histogram_slots_xla
+    from lightgbm_tpu.ops.histogram_pallas import T_ROWS, wave_pass_pallas
+    from lightgbm_tpu.ops.split import (FeatureMeta, SplitHyperParams,
+                                        find_best_split,
+                                        synth_count_channel)
+
+    F, K, KMAX = 28, 4, 8
+    hp = SplitHyperParams(20.0, 1e-3, 0.0, 0.0, 0.0, 0.0, 0.0)
+    rng = np.random.RandomState(42)
+    out = {}
+    for max_bin, B, wide_lo in ((63, 64, 128), (255, 256, 64)):
+        nb = np.full((F,), max_bin + 1, np.int32)
+        X = jnp.asarray(np.stack(
+            [rng.randint(0, b, rows) for b in nb]).astype(np.uint8))
+        vals = jnp.asarray(
+            rng.uniform(-0.5, 0.5, size=(2, rows)).astype(np.float32))
+        lor = jnp.asarray(rng.randint(0, K, size=rows).astype(np.int32))
+
+        tbl = np.full((T_ROWS, 128), -1, np.int32)
+        tbl[7, :K] = np.arange(K)                  # cand leaf ids
+        tbl[8, :K] = 0                             # cand feature
+        tbl[9, :K] = int(nb[0]) // 2 - 1           # cand threshold
+        tbl[10, :K] = 1                            # default_left
+        tbl[11, :K] = 0                            # missing none
+        tbl[12, :K] = 0
+        tbl[13, :K] = nb[0]
+        tbl[14, :K] = 1                            # smaller_is_left
+        tbl[15, :K] = K                            # first new leaf id
+        tbl16 = jnp.asarray(tbl)
+
+        meta = FeatureMeta(num_bins=jnp.asarray(nb),
+                           missing_type=jnp.zeros((F,), jnp.int32),
+                           default_bin=jnp.zeros((F,), jnp.int32),
+                           is_categorical=jnp.zeros((F,), bool))
+        fmask = jnp.ones((F,), bool)
+        parent = jnp.full((KMAX, 2, F, B), float(rows), jnp.float32)
+
+        class _BS:
+            left_sum_g = jnp.zeros((KMAX,), jnp.float32)
+            left_sum_h = jnp.full((KMAX,), rows * 0.25, jnp.float32)
+            left_count = jnp.full((KMAX,), float(rows // K), jnp.float32)
+            left_output = jnp.zeros((KMAX,), jnp.float32)
+            right_sum_g = jnp.zeros((KMAX,), jnp.float32)
+            right_sum_h = jnp.full((KMAX,), rows * 0.25, jnp.float32)
+            right_count = jnp.full((KMAX,), float(rows // K), jnp.float32)
+            right_output = jnp.zeros((KMAX,), jnp.float32)
+
+        sil = jnp.ones((KMAX,), jnp.float32)
+        scal = pack_fused_scalars(_BS, sil, KMAX)
+        meta_ops = pack_fused_meta(meta.num_bins, meta.missing_type,
+                                   meta.default_bin, meta.is_categorical)
+
+        def _scan(hist):
+            hist = jnp.pad(hist,
+                           ((0, KMAX - K), (0, 0), (0, 0), (0, 0)))
+            hs = jnp.concatenate([hist, parent - hist], axis=0)
+            h3 = jax.vmap(synth_count_channel)(
+                hs, jnp.tile(_BS.left_count, 2),
+                jnp.tile(_BS.left_sum_h, 2))
+            res = jax.vmap(lambda hh, sg, sh, c, o: find_best_split(
+                hh, sg, sh, c, o, meta, hp, fmask))(
+                h3, jnp.tile(_BS.left_sum_g, 2),
+                jnp.tile(_BS.left_sum_h, 2),
+                jnp.tile(_BS.left_count, 2),
+                jnp.tile(_BS.left_output, 2))
+            return res.gain
+
+        if on_tpu:
+            def two_pass(X, v, l0):
+                new_lor, hist = wave_pass_pallas(X, v, l0, tbl16, K, B)
+                return new_lor, hist, _scan(hist)
+        else:
+            def two_pass(X, v, l0):
+                hist = _build_histogram_slots_xla(X, v, l0, K, B)
+                return l0, hist, _scan(hist)
+
+        def fused(X, v, l0, _w=wide_lo):
+            return wave_pass_fused_pallas(X, v, l0, tbl16,
+                                          parent.reshape(KMAX, -1), scal,
+                                          meta_ops, K, B, KMAX, hp,
+                                          wide_lo=_w)
+
+        entry = {"rows": rows, "features": F, "num_bins": B,
+                 "cand_leaves": K}
+        best = _time_best(two_pass, (X, vals, lor), reps)
+        entry["two_pass_rows_per_sec"] = round(rows / best, 1)
+        if on_tpu:
+            best = _time_best(fused, (X, vals, lor), reps)
+            entry["fused_rows_per_sec"] = round(rows / best, 1)
+            entry["fused_speedup"] = round(
+                entry["fused_rows_per_sec"]
+                / entry["two_pass_rows_per_sec"], 4)
+        else:
+            # no compiled fused arm off-TPU: record interpret-mode
+            # bitwise parity on a small slice instead of a fake rate
+            m = min(rows, 4096)
+            r_lor, r_hist = wave_pass_pallas(
+                X[:, :m], vals[:, :m], lor[:m], tbl16, K, B,
+                interpret=True)
+            f_lor, f_hist, _ = wave_pass_fused_pallas(
+                X[:, :m], vals[:, :m], lor[:m], tbl16,
+                parent.reshape(KMAX, -1), scal, meta_ops, K, B, KMAX,
+                hp, interpret=True, wide_lo=wide_lo)
+            ok = (np.array_equal(np.asarray(r_lor), np.asarray(f_lor))
+                  and np.array_equal(np.asarray(r_hist),
+                                     np.asarray(f_hist)[:K]))
+            entry["fused_parity"] = "bitwise" if ok else "MISMATCH"
+        out[f"bin{max_bin}"] = entry
+    return out
+
+
+def _pack4_sweep(rows, K, reps, on_tpu):
+    """Row-wise layout with vs without 4-bit packing."""
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.histogram import (_build_histogram_slots_xla,
+                                            build_histogram_slots)
+    from lightgbm_tpu.ops.histogram_rowwise import (
+        _build_histogram_slots_rowwise_xla,
+        build_histogram_slots_rowwise_flat,
+        build_histogram_slots_rowwise_packed_flat, build_pack4_plan,
+        build_rowwise_plan, pack4, pack4_worthwhile)
+    from lightgbm_tpu.utils import round_up
+
+    shapes = {
+        "dense_wide": 28 * (256,),       # unpackable control (>16 bins)
+        "dense_nibble": 64 * (16,),      # max_bin=15 dense table
+        "sparse_onehot": 96 * (8,),      # post-EFB bundle columns
+    }
+    rng = np.random.RandomState(42)
+    out = {}
+    for name, tiers in shapes.items():
+        F = len(tiers)
+        B = max(round_up(max(tiers), 8), 8)
+        rplan = build_rowwise_plan(tiers)
+        pplan = build_pack4_plan(tiers)
+        X = jnp.asarray(np.stack(
+            [rng.randint(0, nb, rows) for nb in tiers]).astype(np.uint8))
+        vals = jnp.asarray(
+            rng.uniform(-0.5, 0.5, size=(2, rows)).astype(np.float32))
+        slot = jnp.asarray(rng.randint(0, K, size=rows).astype(np.int32))
+        entry = {"features": F, "rows": rows, "num_bins": B,
+                 "flat_cols": rplan.total,
+                 "packed_bytes": (pplan.n_packed + 1) // 2
+                 + pplan.n_rest if pplan.n_packed else None}
+
+        if on_tpu:
+            def col(X, v, s, _t=tiers, _B=B):
+                return build_histogram_slots(X, v, s, K, _B, tiers=_t,
+                                             impl="tiered_hilo")
+
+            def row(X, v, s, _t=tiers, _B=B):
+                return build_histogram_slots(X, v, s, K, _B, tiers=_t,
+                                             impl="rowwise")
+
+            def packed(X, v, s, _t=tiers, _B=B):
+                return build_histogram_slots(X, v, s, K, _B, tiers=_t,
+                                             impl="rowwise_packed")
+        else:
+            def col(X, v, s, _B=B):
+                return _build_histogram_slots_xla(X, v, s, K, _B)
+
+            def row(X, v, s, _plan=rplan):
+                return _build_histogram_slots_rowwise_xla(X, v, s, K,
+                                                          _plan)
+            packed = None
+
+        entry["colwise_rows_per_sec"] = round(
+            rows / _time_best(col, (X, vals, slot), reps), 1)
+        entry["rowwise_rows_per_sec"] = round(
+            rows / _time_best(row, (X, vals, slot), reps), 1)
+        if pack4_worthwhile(pplan):
+            if on_tpu:
+                entry["packed_rows_per_sec"] = round(
+                    rows / _time_best(packed, (X, vals, slot), reps), 1)
+                entry["packed_vs_colwise"] = round(
+                    entry["packed_rows_per_sec"]
+                    / entry["colwise_rows_per_sec"], 4)
+            else:
+                m = min(rows, 4096)
+                ref = build_histogram_slots_rowwise_flat(
+                    X[:, :m], vals[:, :m], slot[:m], K, rplan,
+                    interpret=True)
+                Xp, Xu = pack4(X[:, :m], pplan)
+                got = build_histogram_slots_rowwise_packed_flat(
+                    Xp, Xu, vals[:, :m], slot[:m], K, rplan, pplan,
+                    interpret=True)
+                entry["packed_parity"] = (
+                    "bitwise" if np.array_equal(np.asarray(ref),
+                                                np.asarray(got))
+                    else "MISMATCH")
+        out[name] = entry
+    return out
+
+
+def main() -> None:
+    rows = int(os.environ.get("FUSED_ROWS", "120000"))
+    K = int(os.environ.get("FUSED_SLOTS", "8"))
+    reps = int(os.environ.get("FUSED_REPS", "3"))
+
+    import jax
+
+    try:
+        backend = jax.default_backend()
+    except RuntimeError:
+        backend = "none"
+    on_tpu = backend == "tpu"
+
+    print(json.dumps({
+        "metric": "fused_wave_and_pack4",
+        "device": backend,
+        "wave": _wave_sweep(rows, reps, on_tpu),
+        "pack4": _pack4_sweep(rows, K, reps, on_tpu),
+    }))
+
+
+if __name__ == "__main__":
+    main()
