@@ -130,10 +130,14 @@ def main() -> None:
     # BENCH_FUSED=1: fused wave megakernel vs two-pass + 4-bit packed
     # layout sweep (scripts/bench_fused.py, docs/PERF.md section 6);
     # writes BENCH_FUSED.json
+    # BENCH_RESIL=1: checkpointing overhead vs a plain update loop
+    # (scripts/bench_resilience.py, docs/ROBUSTNESS.md); writes
+    # BENCH_RESIL.json
     for env, script in (("BENCH_SERVING", "bench_serving.py"),
                         ("BENCH_ROWWISE", "bench_rowwise.py"),
                         ("BENCH_COMM", "bench_comm.py"),
-                        ("BENCH_FUSED", "bench_fused.py")):
+                        ("BENCH_FUSED", "bench_fused.py"),
+                        ("BENCH_RESIL", "bench_resilience.py")):
         if os.environ.get(env, "") not in ("", "0"):
             import runpy
             runpy.run_path(

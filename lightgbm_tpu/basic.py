@@ -788,9 +788,14 @@ class Booster:
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0,
                    importance_type: str = "split") -> "Booster":
-        with open(filename, "w") as f:
-            f.write(self.model_to_string(num_iteration, start_iteration,
-                                         importance_type))
+        # atomic (write-temp -> fsync -> rename): a concurrent reader —
+        # the serving snapshot watcher in particular — can never observe
+        # a half-written model file (docs/ROBUSTNESS.md)
+        from .runtime.checkpoint import atomic_write_text
+        atomic_write_text(filename,
+                          self.model_to_string(num_iteration,
+                                               start_iteration,
+                                               importance_type))
         return self
 
     def model_to_string(self, num_iteration: Optional[int] = None,

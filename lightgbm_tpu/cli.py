@@ -90,9 +90,13 @@ def run_train(params: Dict[str, Any], cfg) -> None:
             it = env.iteration + 1
             if it % cfg.snapshot_freq == 0:
                 # .txt suffix so the serving registry's snapshot watcher
-                # (task=serve serve_watch=...) can hot-swap these in
-                env.model.save_model(
-                    f"{cfg.output_model}.snapshot_iter_{it}.txt")
+                # (task=serve serve_watch=...) can hot-swap these in;
+                # save_model writes atomically, and the manifest sidecar
+                # lets the watcher checksum-verify before promoting
+                path = f"{cfg.output_model}.snapshot_iter_{it}.txt"
+                env.model.save_model(path)
+                from .runtime.checkpoint import write_manifest
+                write_manifest(path)
         callbacks.append(_snapshot)
     booster = engine_train(params, train_set,
                            num_boost_round=cfg.num_iterations,
@@ -243,9 +247,16 @@ def run_serve(params: Dict[str, Any], cfg) -> None:
         num_iteration=cfg.num_iteration_predict)
     registry.register("default", cfg.input_model)
     if cfg.serve_watch:
+        # when the process booted on a snapshot file, its iteration seeds
+        # the already-served floor so the watcher doesn't re-promote the
+        # very model it just loaded (registry also persists the floor
+        # across restarts in <prefix>.watch_state.json)
+        from .serving.registry import _SNAP_RE
+        m = _SNAP_RE.search(str(cfg.input_model))
         registry.watch_snapshots("default", cfg.serve_watch,
                                  poll_s=cfg.serve_watch_poll_s,
-                                 start=cfg.serve_port > 0)
+                                 start=cfg.serve_port > 0,
+                                 initial_iter=int(m.group(1)) if m else -1)
     batcher = MicroBatcher(
         lambda X: registry.predict(X, raw_score=cfg.predict_raw_score),
         max_batch=cfg.serve_max_batch, max_wait_ms=cfg.serve_batch_wait_ms,

@@ -153,6 +153,14 @@ _alias("serve_request_timeout_ms", "serve_timeout_ms")
 _alias("serve_num_shards", "serving_num_shards")
 _alias("serve_watch", "snapshot_watch", "watch_model")
 _alias("serve_metrics_output", "serve_metrics_out", "serving_metrics_file")
+_alias("checkpoint_interval", "checkpoint_freq", "ckpt_interval")
+_alias("checkpoint_dir", "checkpoint_path", "ckpt_dir")
+_alias("checkpoint_retention", "checkpoint_keep", "ckpt_retention")
+_alias("resume_from_checkpoint", "resume_checkpoint", "resume")
+_alias("fault_plan", "fault_injection")
+_alias("step_max_retries", "watchdog_retries")
+_alias("step_retry_backoff_s", "watchdog_backoff_s")
+_alias("straggler_skew_threshold", "straggler_threshold")
 
 
 @dataclass
@@ -400,6 +408,21 @@ class Config:
     # value with another learner is a config contradiction.
     parallel_hist_mode: str = "auto"
 
+    # -- resilience (runtime/checkpoint.py + runtime/faults.py,
+    # docs/ROBUSTNESS.md). All off by default: checkpoint_interval=0
+    # leaves the training hot path byte-for-byte unchanged.
+    checkpoint_interval: int = 0       # iterations between checkpoints
+    checkpoint_dir: str = ""           # where ckpt_iter_*.pkl land
+    checkpoint_retention: int = 3      # newest checkpoints kept on disk
+    resume_from_checkpoint: str = ""   # checkpoint file or directory
+    fault_plan: str = ""               # injection spec (tests/smoke only;
+    #                                    env LIGHTGBM_TPU_FAULT_PLAN also
+    #                                    works for subprocess harnesses)
+    step_max_retries: int = 2          # watchdog retries per grow step
+    step_retry_backoff_s: float = 0.05  # base backoff, doubles per retry
+    straggler_skew_threshold: float = 1.5  # flag ranks slower than this
+    #                                    multiple of the median grow span
+
     def __post_init__(self) -> None:
         self._validate()
 
@@ -474,6 +497,22 @@ class Config:
                 f"tree_learner=data (got tree_learner="
                 f"'{self.tree_learner}'); the histogram exchange only "
                 "exists for the data-parallel learner — docs/PERF.md")
+        if self.checkpoint_interval < 0:
+            log_fatal("checkpoint_interval should be >= 0 (0 disables "
+                      "checkpointing)")
+        if self.checkpoint_interval > 0 and not self.checkpoint_dir:
+            log_fatal("checkpoint_interval > 0 requires checkpoint_dir "
+                      "(where ckpt_iter_*.pkl snapshots are written — "
+                      "docs/ROBUSTNESS.md)")
+        if self.checkpoint_retention < 1:
+            log_fatal("checkpoint_retention should be >= 1")
+        if self.step_max_retries < 0:
+            log_fatal("step_max_retries should be >= 0")
+        if self.step_retry_backoff_s < 0.0:
+            log_fatal("step_retry_backoff_s should be >= 0.0")
+        if self.straggler_skew_threshold <= 1.0:
+            log_fatal("straggler_skew_threshold should be > 1.0 (it is a "
+                      "ratio over the median rank span)")
 
     def max_depth_effective(self) -> int:
         return self.max_depth if self.max_depth > 0 else 10**9
@@ -481,12 +520,24 @@ class Config:
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
 
+    # run-orchestration knobs excluded from the model-file parameter echo:
+    # they describe how one particular run was EXECUTED (where it
+    # checkpointed, what it resumed from, what faults were injected), not
+    # what model it produces — and a resumed run must emit byte-identical
+    # model files to the uninterrupted run it replaces (docs/ROBUSTNESS.md)
+    _NON_MODEL_FIELDS = frozenset((
+        "checkpoint_interval", "checkpoint_dir", "checkpoint_retention",
+        "resume_from_checkpoint", "fault_plan", "step_max_retries",
+        "step_retry_backoff_s", "straggler_skew_threshold"))
+
     def to_string(self) -> str:
         """Serialize `[key: value]` lines, the reference's Config::ToString
         layout used inside model files (gbdt_model_text.cpp parameters
         section)."""
         lines = []
         for f in dataclasses.fields(self):
+            if f.name in self._NON_MODEL_FIELDS:
+                continue
             v = getattr(self, f.name)
             if isinstance(v, bool):
                 v = int(v)

@@ -74,23 +74,58 @@ def train(
     callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
     callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
+    # -- resilience: iteration checkpointing and crash resume
+    # (runtime/checkpoint.py, docs/ROBUSTNESS.md). Both default off; the
+    # checkpointed/resumed loop must take the per-iteration path below —
+    # the same path for save and resume runs is part of the bit-identical
+    # guarantee — so the batched fast-path is gated on them being off.
+    ckpt_mgr = None
+    begin_iter = 0
+    if cfg.checkpoint_interval > 0:
+        from .runtime.checkpoint import CheckpointManager
+        from .runtime.faults import active_plan
+        ckpt_mgr = CheckpointManager(cfg.checkpoint_dir,
+                                     retention=cfg.checkpoint_retention,
+                                     fault_plan=active_plan(cfg.fault_plan))
+    if cfg.resume_from_checkpoint:
+        from .runtime.checkpoint import (load_checkpoint,
+                                         restore_trainer_state)
+        state = load_checkpoint(cfg.resume_from_checkpoint)
+        restore_trainer_state(booster._gbdt, state)
+        if int(state.get("best_iteration", -1)) > 0:
+            booster.best_iteration = int(state["best_iteration"])
+        begin_iter = booster._gbdt.iter
+        if begin_iter >= num_boost_round:
+            log_info(f"checkpoint already holds {begin_iter} iterations "
+                     f">= num_boost_round={num_boost_round}; nothing to do")
+
     # whole-chunk device training when nothing needs per-iteration host
     # interaction (no callbacks/eval/custom objective): the boosting loop
     # runs as jitted scans with zero host round-trips
     if (not callbacks_before and not callbacks_after and fobj is None
             and feval is None and not valid_contain_train
             and not booster.name_valid_sets
+            and ckpt_mgr is None and begin_iter == 0
+            and not cfg.resume_from_checkpoint
             and booster._gbdt.can_batch_iters(num_boost_round)):
         booster.update_batch(num_boost_round)
         booster.best_iteration = booster.current_iteration
         return booster
 
-    for it in range(num_boost_round):
+    for it in range(begin_iter, num_boost_round):
         for cb in callbacks_before:
             cb(CallbackEnv(model=booster, params=params, iteration=it,
-                           begin_iteration=0, end_iteration=num_boost_round,
+                           begin_iteration=begin_iter,
+                           end_iteration=num_boost_round,
                            evaluation_result_list=None))
         finished = booster.update(fobj=fobj)
+        if ckpt_mgr is not None \
+                and booster._gbdt.iter % cfg.checkpoint_interval == 0:
+            from .runtime.checkpoint import capture_trainer_state
+            ckpt_mgr.save(
+                capture_trainer_state(booster._gbdt,
+                                      best_iteration=booster.best_iteration),
+                booster._gbdt.iter)
 
         evaluation_result_list = []
         if valid_contain_train:
@@ -102,7 +137,7 @@ def train(
         try:
             for cb in callbacks_after:
                 cb(CallbackEnv(model=booster, params=params, iteration=it,
-                               begin_iteration=0,
+                               begin_iteration=begin_iter,
                                end_iteration=num_boost_round,
                                evaluation_result_list=evaluation_result_list))
         except EarlyStopException as e:

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -142,6 +143,8 @@ class GBDT:
     """Gradient Boosting Decision Trees (reference: src/boosting/gbdt.h:35)."""
 
     _pre_part = False            # set by _init_train when pre-partitioned
+    _fault_plan = None           # resilience: runtime/faults.py plan or None
+    _collective_failures = 0     # watchdog: histogram-exchange error count
 
     def __init__(self, config: Config, train_set: Optional[BinnedDataset],
                  objective: Optional[ObjectiveFunction],
@@ -189,6 +192,12 @@ class GBDT:
         if cfg.device_profile:
             from ..runtime import StageProfiler
             self.profiler = StageProfiler()
+            self.profiler.straggler_threshold = float(
+                cfg.straggler_skew_threshold)
+        # deterministic fault injection (runtime/faults.py); None — the
+        # default — costs one `is None` check per iteration
+        from ..runtime.faults import active_plan
+        self._fault_plan = active_plan(cfg.fault_plan)
         self.num_data = ds.num_data
         self.max_feature_idx_ = ds.num_total_features - 1
         self.feature_names_ = list(ds.feature_names)
@@ -276,6 +285,8 @@ class GBDT:
         else:
             X = ds.X_binned
         self.num_bins_padded = max(_round_up(max_bin, 8), 8)
+        self._max_bin = max_bin   # autotune cache key component (degrade
+        #                           path re-pins under the same key)
         Xt_np = np.ascontiguousarray(X.T)                   # [F(b), N]
         if self._host_pad != N_real:
             Xt_np = np.pad(Xt_np, ((0, 0), (0, self._host_pad - N_real)))
@@ -1108,6 +1119,8 @@ class GBDT:
                        hess: Optional[np.ndarray] = None) -> bool:
         """One boosting iteration (GBDT::TrainOneIter, gbdt.cpp:353).
         Returns True if training should stop (no splits possible)."""
+        if self._fault_plan is not None:
+            self._fault_plan.at_iteration(self.iter)
         K = self.num_tree_per_iteration
         prof = self.profiler
         if prof is not None:
@@ -1153,10 +1166,12 @@ class GBDT:
         lr = jnp.float32(self.shrinkage_rate)
         feat_mask = self._feature_mask_for_iter()
         base_seed = self.config.seed or 0
+        t_grow0 = (time.perf_counter()
+                   if (prof is not None and self._pre_part) else None)
         for k in range(K):
           with global_timer.section("GBDT::TrainOneIter/grow"):
             with self._prof_span("grow"):
-                tree_dev, leaf_of_row, new_scores = self._train_tree(
+                tree_dev, leaf_of_row, new_scores = self._grow_step(
                     self.X_t, g_dev[k], h_dev[k],
                     in_bag if in_bag.ndim == 1 else in_bag[k],
                     self.scores[k], lr, feat_mask,
@@ -1199,6 +1214,8 @@ class GBDT:
             bias = init_scores[k] if self.iter == 0 else 0.0
             self._pending.append((tree_dev, float(bias)))
 
+        if t_grow0 is not None:
+            self._record_grow_skew(time.perf_counter() - t_grow0)
         self.iter += 1
         if prof is not None:
             prof.iter_end(n_rows=self.num_data)
@@ -1225,6 +1242,100 @@ class GBDT:
             self._stopped = self._check_stopped()
             return self._stopped
         return False
+
+    # ------------------------------------------------------------------
+    # resilience: step watchdog + comm-mode degradation + straggler feed
+    # (docs/ROBUSTNESS.md)
+    def _grow_step(self, X_t, g, h, in_bag, scores_k, lr, feat_mask, seed):
+        """Watchdog around the jitted tree-grow dispatch: bounded retry
+        with exponential backoff for transient device/step errors, plus
+        a one-way reduce_scatter -> allreduce degrade of the histogram
+        exchange after repeated collective failures (re-pinned into the
+        autotune cache so the next run of this shape skips the broken
+        collective). Tree growth is a pure function of its inputs, so a
+        retry after a transient fault cannot change the trained model."""
+        if self._fault_plan is None and self.config.step_max_retries == 0:
+            return self._train_tree(X_t, g, h, in_bag, scores_k, lr,
+                                    feat_mask, seed)
+        attempt = 0
+        while True:
+            try:
+                if self._fault_plan is not None:
+                    self._fault_plan.maybe_fail_collective(self.iter)
+                return self._train_tree(X_t, g, h, in_bag, scores_k, lr,
+                                        feat_mask, seed)
+            except Exception as e:
+                from ..parallel import is_collective_error
+                if is_collective_error(e):
+                    self._collective_failures += 1
+                    log_warning(
+                        f"histogram-exchange failure "
+                        f"#{self._collective_failures} at iteration "
+                        f"{self.iter}: {e}")
+                    if self._collective_failures >= 2 \
+                            and self._degrade_comm_mode(reason=repr(e)):
+                        continue        # degraded exchange; retry at once
+                attempt += 1
+                if attempt > self.config.step_max_retries:
+                    raise
+                backoff = self.config.step_retry_backoff_s \
+                    * (2 ** (attempt - 1))
+                log_warning(
+                    f"grow step failed at iteration {self.iter} (attempt "
+                    f"{attempt}/{self.config.step_max_retries}): {e}; "
+                    f"retrying in {backoff:.3f}s")
+                if backoff > 0:
+                    time.sleep(backoff)
+
+    def _degrade_comm_mode(self, reason: str = "") -> bool:
+        """reduce_scatter -> allreduce fallback: allreduce moves more
+        bytes but is the simpler collective (no feature-slice ownership,
+        no winner sync), so it is the safe harbor when the scatter path
+        keeps failing. One-way; returns True when a degrade happened."""
+        if not (self.use_dist and not self._feat_par):
+            return False
+        mode = str(self.grow_cfg.parallel_hist_mode)
+        if mode == "auto":
+            cp = getattr(self, "_comm_profile", None) or {}
+            mode = str(cp.get("comm_mode", "allreduce"))
+        if mode == "allreduce":
+            return False
+        log_warning(f"degrading histogram exchange '{mode}' -> "
+                    "'allreduce' after repeated collective failures; "
+                    "pinning the choice in the autotune cache")
+        self.grow_cfg = self.grow_cfg._replace(
+            parallel_hist_mode="allreduce")
+        try:
+            from ..runtime.autotune import pin_comm_decision
+            self.autotune_decision = pin_comm_decision(
+                n_rows=self.num_data,
+                n_features=int(self.X_t.shape[0]),
+                max_bin=self._max_bin,
+                num_leaves=self.config.num_leaves,
+                mesh_size=self.n_shards,
+                mode="allreduce",
+                cache_path=self.config.autotune_cache,
+                reason=reason or "repeated collective failures")
+        except Exception:
+            pass    # a cache miss next run, never a training failure
+        self._comm_profile = self._comm_iter_profile()
+        if self.profiler is not None and self._comm_profile:
+            self.profiler.extras["comm"] = dict(self._comm_profile)
+        self._build_jit_fns()
+        return True
+
+    def _record_grow_skew(self, span_s: float) -> None:
+        """Feed this rank's grow wall into the cross-rank straggler
+        detector (runtime/profiler.py). Multi-host only: on a single
+        host all shards share one dispatch clock, so per-rank skew is
+        unobservable from here (tests feed synthetic spans instead)."""
+        try:
+            from jax.experimental import multihost_utils
+            spans = np.asarray(multihost_utils.process_allgather(
+                np.asarray([span_s], np.float64))).reshape(-1)
+            self.profiler.record_rank_spans("grow", spans)
+        except Exception:
+            pass
 
     def load_init_model(self, init) -> None:
         """Continued training from an existing model (reference:

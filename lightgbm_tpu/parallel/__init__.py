@@ -8,9 +8,30 @@ from .data_parallel import (build_data_parallel_train_fn,
                             replicated, shard_rows)
 from .distributed import init_distributed
 
+# error-message fragments that mark a failed collective (XLA surfaces
+# these as generic RuntimeError/XlaRuntimeError; the substrings are the
+# only portable signal). The training watchdog uses this to decide
+# between a plain retry and the histogram-exchange degrade ladder
+# (models/gbdt.py _grow_step, docs/ROBUSTNESS.md).
+COLLECTIVE_ERROR_MARKERS = ("collective", "all-reduce", "allreduce",
+                            "all-gather", "allgather", "reduce-scatter",
+                            "reduce_scatter", "psum", "ppermute",
+                            "nccl", "megascale")
+
+
+def is_collective_error(exc: BaseException) -> bool:
+    """True when `exc` looks like a failed cross-device collective
+    (injected CollectiveFault or a runtime error naming one)."""
+    from ..runtime.faults import CollectiveFault
+    if isinstance(exc, CollectiveFault):
+        return True
+    msg = str(exc).lower()
+    return any(m in msg for m in COLLECTIVE_ERROR_MARKERS)
+
+
 __all__ = [
     "DATA_AXIS", "FEATURE_AXIS", "DistContext", "make_data_mesh",
     "build_data_parallel_train_fn", "build_sharded_score_fn",
     "lane_multiple", "pad_rows_to", "shard_rows", "replicated",
-    "init_distributed",
+    "init_distributed", "COLLECTIVE_ERROR_MARKERS", "is_collective_error",
 ]

@@ -15,10 +15,17 @@ This package is that idea generalized for the TPU build:
    histogram chunk layouts on a subsample of the real binned matrix;
    the winner is cached in-process and on disk keyed by
    (n_rows, n_features, max_bin, num_leaves, device kind).
+ * `checkpoint` — iteration-level deterministic checkpoint/resume:
+   atomic snapshot writes with checksummed manifests, bounded
+   retention, and bit-identical crash recovery (docs/ROBUSTNESS.md).
+ * `faults`    — deterministic fault-injection plans for resilience
+   tests (kill/raise/sleep/corrupt_snapshot/fail_collective).
 
 Enabled through config: `device_profile=true` (alias `profile`, CLI
-`--profile`) and `autotune=true`. Both default off; `autotune=false`
-reproduces the hard-coded strategy ladder bit-for-bit.
+`--profile`), `autotune=true`, `checkpoint_interval>0`. All default
+off; `autotune=false` reproduces the hard-coded strategy ladder
+bit-for-bit and `checkpoint_interval=0` leaves the training hot path
+untouched.
 
 Imports stay lazy/light here: this module must be importable before any
 XLA backend is initialized (multi-host bring-up orders
@@ -27,4 +34,12 @@ jax.distributed.initialize before the first backend touch).
 
 from .profiler import StageProfiler, Timer, global_timer, trace  # noqa: F401
 from .autotune import (AUTOTUNE_PREFERENCE, autotune_decision,  # noqa: F401
-                       load_disk_cache, make_key, save_disk_cache)
+                       load_disk_cache, make_key, pin_comm_decision,
+                       save_disk_cache)
+from .checkpoint import (CheckpointError, CheckpointManager,  # noqa: F401
+                         atomic_write_bytes, atomic_write_text,
+                         capture_trainer_state, load_checkpoint,
+                         restore_trainer_state, verify_manifest,
+                         write_manifest)
+from .faults import (CollectiveFault, FaultPlan,  # noqa: F401
+                     InjectedFault, active_plan)

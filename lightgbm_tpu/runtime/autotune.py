@@ -496,6 +496,34 @@ def autotune_comm_decision(mesh, *, n_rows: int, n_features: int,
     return dict(decision, cached=False)
 
 
+def pin_comm_decision(*, n_rows: int, n_features: int, max_bin: int,
+                      num_leaves: int, mesh_size: int, mode: str,
+                      cache_path: str = "", reason: str = "",
+                      ) -> Dict[str, Any]:
+    """Overwrite the cached comm decision with a forced ``mode`` under
+    the same key ``autotune_comm_decision`` reads. The training
+    watchdog's reduce_scatter -> allreduce degrade calls this to POISON
+    the broken mode (models/gbdt.py _degrade_comm_mode): the very next
+    run of the same shape/mesh starts on the safe exchange instead of
+    re-discovering the failure. Both exchanges produce bit-identical
+    trees, so pinning only changes the wire profile."""
+    key = make_key(n_rows, n_features, max_bin, num_leaves) \
+        + f"_mesh{int(mesh_size)}"
+    decision: Dict[str, Any] = {
+        "parallel_hist_mode": str(mode),
+        "key": key,
+        "mesh_size": int(mesh_size),
+        "pinned": True,
+        "reason": str(reason),
+    }
+    _MEM_CACHE[key] = decision
+    path = cache_path or default_cache_path()
+    disk = load_disk_cache(path)
+    disk[key] = decision
+    save_disk_cache(path, disk)
+    return decision
+
+
 def _pick_winner(timings: Dict[str, float],
                  preference: Sequence[str]) -> Optional[str]:
     """Fastest candidate; ties within TIE_TOL resolve by preference

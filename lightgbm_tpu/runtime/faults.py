@@ -1,0 +1,155 @@
+"""Deterministic fault injection for resilience tests and smoke runs.
+
+A fault PLAN is a ``;``/``,``-separated list of directives, each
+``action@key=value[:key=value...]`` (docs/ROBUSTNESS.md):
+
+    kill@iter=7                   os._exit(17) before iteration 7 runs
+    raise@iter=3                  raise InjectedFault before iteration 3
+    sleep@iter=2:rank=1:ms=250    straggle rank 1 for 250ms at iteration 2
+    corrupt_snapshot@iter=8       flip bytes in the checkpoint written at
+                                  iteration 8 (its manifest then fails)
+    fail_collective@iter=2:times=2  the histogram exchange raises
+                                  CollectiveFault `times` times starting
+                                  at iteration 2 (drives the watchdog's
+                                  reduce_scatter -> allreduce degrade)
+
+``times`` defaults to 1 everywhere. Plans come from config
+``fault_plan=...`` or the LIGHTGBM_TPU_FAULT_PLAN env var; with no plan
+the training hot path pays exactly one ``is None`` check per iteration.
+
+Stdlib-only at the top level (imported eagerly by ``runtime/__init__``).
+"""
+
+import os
+import re
+import sys
+import time
+from typing import Dict, List, Optional
+
+KILL_EXIT_CODE = 17
+
+_ACTIONS = ("kill", "raise", "sleep", "corrupt_snapshot", "fail_collective")
+
+
+class InjectedFault(RuntimeError):
+    """An error raised on purpose by the fault-injection harness."""
+
+
+class CollectiveFault(InjectedFault):
+    """An injected histogram-exchange (collective) failure."""
+
+
+class _Directive:
+    __slots__ = ("action", "params", "remaining")
+
+    def __init__(self, action: str, params: Dict[str, str]):
+        self.action = action
+        self.params = params
+        self.remaining = int(params.get("times", 1))
+
+    def __repr__(self):
+        kv = ":".join(f"{k}={v}" for k, v in sorted(self.params.items()))
+        return f"{self.action}@{kv}" if kv else self.action
+
+
+def _rank() -> int:
+    try:
+        import jax
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+class FaultPlan:
+    """Parsed plan; directives are consumed (``times`` decrements) so a
+    resumed process re-reading the same plan replays deterministically
+    from its own start."""
+
+    def __init__(self, directives: List[_Directive], spec: str):
+        self.directives = directives
+        self.spec = spec
+
+    def __repr__(self):
+        return f"FaultPlan({self.spec!r})"
+
+    @classmethod
+    def parse(cls, spec: str) -> Optional["FaultPlan"]:
+        spec = (spec or "").strip()
+        if not spec:
+            return None
+        directives = []
+        for tok in re.split(r"[;,]", spec):
+            tok = tok.strip()
+            if not tok:
+                continue
+            action, _, rest = tok.partition("@")
+            action = action.strip()
+            if action not in _ACTIONS:
+                raise ValueError(
+                    f"unknown fault action {action!r} in plan {spec!r}; "
+                    f"known: {', '.join(_ACTIONS)}")
+            params: Dict[str, str] = {}
+            for kv in filter(None, (p.strip() for p in rest.split(":"))):
+                k, _, v = kv.partition("=")
+                params[k.strip()] = v.strip()
+            directives.append(_Directive(action, params))
+        return cls(directives, spec)
+
+    # -- hooks ------------------------------------------------------------
+
+    def at_iteration(self, it: int) -> None:
+        """Training-loop hook, called once before iteration `it` runs;
+        fires kill / raise / sleep directives pinned to that iteration."""
+        for d in self.directives:
+            if d.remaining <= 0 or int(d.params.get("iter", -1)) != int(it):
+                continue
+            if d.action == "kill":
+                sys.stdout.flush()
+                sys.stderr.flush()
+                os._exit(int(d.params.get("code", KILL_EXIT_CODE)))
+            elif d.action == "raise":
+                d.remaining -= 1
+                raise InjectedFault(f"injected fault at iteration {it}")
+            elif d.action == "sleep":
+                if int(d.params.get("rank", 0)) != _rank():
+                    continue
+                d.remaining -= 1
+                time.sleep(float(d.params.get("ms", 100.0)) / 1e3)
+
+    def maybe_fail_collective(self, it: int) -> None:
+        """Histogram-exchange hook (models/gbdt.py _grow_step)."""
+        for d in self.directives:
+            if d.action == "fail_collective" and d.remaining > 0 \
+                    and int(it) >= int(d.params.get("iter", 0)):
+                d.remaining -= 1
+                raise CollectiveFault(
+                    f"injected collective failure at iteration {it}")
+
+    def should_corrupt_snapshot(self, iteration: int) -> bool:
+        """Checkpoint-write hook (runtime/checkpoint.py); consumed once."""
+        for d in self.directives:
+            if d.action == "corrupt_snapshot" and d.remaining > 0 \
+                    and int(d.params.get("iter", -1)) == int(iteration):
+                d.remaining -= 1
+                return True
+        return False
+
+
+def active_plan(spec: str = "") -> Optional[FaultPlan]:
+    """Plan from the explicit spec, else LIGHTGBM_TPU_FAULT_PLAN, else
+    None (the zero-overhead default)."""
+    return FaultPlan.parse(
+        spec or os.environ.get("LIGHTGBM_TPU_FAULT_PLAN", ""))
+
+
+def corrupt_file(path: str, offset_frac: float = 0.4,
+                 nbytes: int = 64) -> None:
+    """Deterministically overwrite bytes mid-file, keeping its size —
+    the shape of a bad sector / torn buffer, detectable only by
+    checksum (manifest verification, not a size check, must catch it)."""
+    size = os.path.getsize(path)
+    off = max(int(size * offset_frac), 0)
+    n = max(min(nbytes, size - off), 4)
+    with open(path, "r+b") as f:
+        f.seek(off)
+        f.write(b"\xde\xad\xbe\xef" * (n // 4))

@@ -129,6 +129,12 @@ def _hbm_peak_bytes() -> Optional[int]:
         return None
 
 
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
 class StageProfiler:
     """Per-iteration stage spans, device-fenced, with a ring buffer.
 
@@ -162,6 +168,11 @@ class StageProfiler:
         self._iter_t0: Optional[float] = None
         self._iter_spans: Optional[Dict[str, float]] = None
         self._iter_fields: Optional[Dict[str, Any]] = None
+        # cross-rank straggler detection (docs/ROBUSTNESS.md): per-stage
+        # lists of per-iteration [rank0_s, rank1_s, ...] span rows, fed
+        # by the multi-host training loop (or synthetically by tests)
+        self.rank_spans: Dict[str, List[List[float]]] = {}
+        self.straggler_threshold = 1.5
 
     # -- span recording ---------------------------------------------------
 
@@ -228,6 +239,42 @@ class StageProfiler:
     def add_counter(self, name: str, value: float) -> None:
         self.counters[name] = self.counters.get(name, 0.0) + value
 
+    # -- straggler detection ----------------------------------------------
+
+    def record_rank_spans(self, stage: str, spans,
+                          threshold: Optional[float] = None) -> None:
+        """One iteration's per-rank wall seconds for ``stage``."""
+        if threshold is not None:
+            self.straggler_threshold = float(threshold)
+        row = [float(s) for s in spans]
+        if row:
+            self.rank_spans.setdefault(stage, []).append(row)
+
+    def straggler_report(self) -> Dict[str, Any]:
+        """Cross-rank span skew per stage: each rank's mean span over
+        the recorded iterations, the cross-rank median, and the ranks
+        whose mean exceeds ``straggler_threshold`` x median — a
+        persistently slow rank, not one noisy iteration."""
+        out: Dict[str, Any] = {}
+        for stage, rows in self.rank_spans.items():
+            n_ranks = min(len(r) for r in rows)
+            if n_ranks == 0:
+                continue
+            mean = [sum(r[i] for r in rows) / len(rows)
+                    for i in range(n_ranks)]
+            med = _median(mean)
+            out[stage] = {
+                "n_iters": len(rows),
+                "mean_s_by_rank": [round(v, 6) for v in mean],
+                "median_s": round(med, 6),
+                "skew": round(max(mean) / med, 4) if med > 0 else 0.0,
+                "threshold": self.straggler_threshold,
+                "straggler_ranks": [
+                    i for i, v in enumerate(mean)
+                    if med > 0 and v > self.straggler_threshold * med],
+            }
+        return out
+
     # -- export -----------------------------------------------------------
 
     def row_iters_per_sec(self) -> Optional[float]:
@@ -253,6 +300,8 @@ class StageProfiler:
                                for n, v in self.counters.items()}
         if self.hbm_peak_bytes is not None:
             out["hbm_peak_bytes"] = self.hbm_peak_bytes
+        if self.rank_spans:
+            out["stragglers"] = self.straggler_report()
         if self.extras:
             out.update(self.extras)
         return out

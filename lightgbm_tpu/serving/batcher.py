@@ -16,7 +16,12 @@ Back-pressure and failure semantics:
    request ABANDONED, and the worker drops abandoned requests at batch
    assembly so their rows aren't scored;
  * a scoring error is delivered to exactly the requests in that batch;
-   the worker survives and keeps serving.
+   the worker survives and keeps serving;
+ * a FATAL worker error (anything outside the per-batch scoring guard)
+   is delivered to every in-flight and queued request, the batcher is
+   marked stopped, and subsequent ``submit`` calls fail fast naming the
+   original error — a dead worker never strands callers waiting out
+   their timeouts undiagnosed (docs/ROBUSTNESS.md).
 """
 
 from __future__ import annotations
@@ -74,6 +79,7 @@ class MicroBatcher:
         self._carry: Optional[_Request] = None   # overflow from last batch
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        self._fatal: Optional[BaseException] = None  # worker-death cause
         # observability: sizes of the batches actually scored
         self.batch_sizes: List[int] = []
 
@@ -111,6 +117,9 @@ class MicroBatcher:
     def submit(self, x) -> _Request:
         """Enqueue one request (a single row or a small [n, F] block).
         Non-blocking; raises QueueFullError under back-pressure."""
+        if self._fatal is not None:
+            raise RuntimeError(
+                f"serving worker died: {self._fatal!r}") from self._fatal
         if not self._running:
             raise RuntimeError("batcher not started")
         x = np.asarray(x, np.float64)
@@ -175,25 +184,54 @@ class MicroBatcher:
         return batch
 
     def _loop(self) -> None:
-        while self._running:
-            batch = [r for r in self._gather() if not r.abandoned]
-            if not batch:
-                continue
-            X = batch[0].x if len(batch) == 1 else \
-                np.concatenate([r.x for r in batch], axis=0)
-            self.batch_sizes.append(X.shape[0])
-            try:
-                out = self.predict_fn(X)
-            except BaseException as e:   # deliver, don't die
-                if self.metrics is not None:
-                    self.metrics.inc("errors", len(batch))
-                for r in batch:
-                    r.error = e
+        batch: List[_Request] = []
+        try:
+            while self._running:
+                batch = [r for r in self._gather() if not r.abandoned]
+                if not batch:
+                    continue
+                try:
+                    X = batch[0].x if len(batch) == 1 else \
+                        np.concatenate([r.x for r in batch], axis=0)
+                    self.batch_sizes.append(X.shape[0])
+                    out = np.asarray(self.predict_fn(X))
+                    results = []
+                    off = 0
+                    for r in batch:
+                        results.append(out[off:off + r.n])
+                        off += r.n
+                except BaseException as e:   # deliver, don't die
+                    if self.metrics is not None:
+                        self.metrics.inc("errors", len(batch))
+                    for r in batch:
+                        r.error = e
+                        r.event.set()
+                    continue
+                for r, res in zip(batch, results):
+                    r.result = res
                     r.event.set()
-                continue
-            out = np.asarray(out)
-            off = 0
-            for r in batch:
-                r.result = out[off:off + r.n]
-                off += r.n
-                r.event.set()
+                batch = []
+        except BaseException as e:
+            # anything escaping the per-batch guard would otherwise kill
+            # this thread silently and strand every waiter: record the
+            # cause, fail the in-flight batch and the whole queue, and
+            # make the batcher refuse new work
+            self._die(e, batch)
+
+    def _die(self, exc: BaseException, batch: List[_Request]) -> None:
+        self._fatal = exc
+        self._running = False
+        if self.metrics is not None:
+            self.metrics.inc("worker_deaths")
+        err = RuntimeError(f"serving worker died: {exc!r}")
+        err.__cause__ = exc
+        for r in batch:
+            r.error = err
+            r.event.set()
+        while True:
+            try:
+                r = self._q.get_nowait()
+            except queue.Empty:
+                break
+            r.error = err
+            r.event.set()

@@ -13,21 +13,60 @@ The snapshot watcher closes the loop with training: ``task=train`` with
 ``<output_model>.snapshot_iter_<k>.txt``; ``watch_snapshots`` polls that
 prefix and promotes the highest-iteration snapshot it hasn't served yet —
 continuous deployment of a model still being trained.
+
+Publish-path hardening (docs/ROBUSTNESS.md): a candidate snapshot must
+pass validation — manifest checksum when a ``.manifest.json`` sidecar
+exists, and a structural truncation check always — before it is parsed;
+a rejected or unloadable snapshot is remembered (by path/mtime/size) and
+skipped, and the registry keeps serving the old session. The last
+promoted iteration is persisted next to the snapshots, so a restarted
+serve process does not re-promote what it already served.
 """
 
 from __future__ import annotations
 
 import glob
+import json
 import os
 import re
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
-from ..utils.log import log_info
+from ..utils.log import log_info, log_warning
 from .metrics import ServingMetrics
 from .session import ServingSession
 
 _SNAP_RE = re.compile(r"\.snapshot_iter_(\d+)(?:\.txt)?$")
+
+# complete model text ends with the parameter block (save_model_to_string)
+# followed by the Booster-appended pandas_categorical line; the parameter
+# sentinel inside the last chunk is the cheap truncation probe
+_MODEL_EOF_MARKER = b"end of parameters"
+_EOF_PROBE_BYTES = 4096
+
+
+def _snapshot_valid(path: str) -> Tuple[bool, str]:
+    """(ok, reason). Checksum-verify against the manifest sidecar when
+    the producer wrote one (runtime/checkpoint.py write_manifest);
+    always run the structural truncation probe — atomic writers can't
+    produce a torn file, but a copied/rsynced snapshot can."""
+    try:
+        size = os.path.getsize(path)
+    except OSError as e:
+        return False, f"unreadable: {e}"
+    if size == 0:
+        return False, "empty file"
+    from ..runtime.checkpoint import manifest_path, verify_manifest
+    if os.path.exists(manifest_path(path)):
+        ok, reason = verify_manifest(path)
+        if not ok:
+            return False, reason
+    with open(path, "rb") as f:
+        f.seek(max(size - _EOF_PROBE_BYTES, 0))
+        tail = f.read()
+    if _MODEL_EOF_MARKER not in tail:
+        return False, "truncated (no end-of-parameters marker)"
+    return True, "ok"
 
 
 def _load_gbdt(model: Any):
@@ -47,16 +86,42 @@ def _load_gbdt(model: Any):
 
 
 class _Watch:
-    __slots__ = ("prefix", "opts", "last_iter", "poll_s", "thread", "stop")
+    __slots__ = ("prefix", "opts", "last_iter", "poll_s", "thread", "stop",
+                 "state_path", "rejected")
 
-    def __init__(self, prefix: str, opts: Dict[str, Any],
-                 poll_s: float) -> None:
+    def __init__(self, prefix: str, opts: Dict[str, Any], poll_s: float,
+                 initial_iter: int = -1,
+                 state_file: Optional[str] = None) -> None:
         self.prefix = prefix
         self.opts = opts
-        self.last_iter = -1
         self.poll_s = poll_s
         self.thread: Optional[threading.Thread] = None
         self.stop = threading.Event()
+        # restart amnesia fix: the last promoted iteration is persisted
+        # next to the snapshots and reloaded here, so a restarted serve
+        # process skips the no-op re-promotion of what it already served
+        self.state_path = (state_file if state_file is not None
+                           else prefix + ".watch_state.json")
+        self.last_iter = max(int(initial_iter), self._load_state())
+        # snapshots that failed validation/promotion, keyed by
+        # (path, mtime_ns, size): never retried unless rewritten
+        self.rejected: set = set()
+
+    def _load_state(self) -> int:
+        try:
+            with open(self.state_path) as f:
+                return int(json.load(f).get("last_iter", -1))
+        except Exception:
+            return -1
+
+    def save_state(self) -> None:
+        try:
+            from ..runtime.checkpoint import atomic_write_text
+            atomic_write_text(self.state_path,
+                              json.dumps({"last_iter": self.last_iter}))
+        except Exception as e:
+            log_warning(f"serving: could not persist watch state to "
+                        f"{self.state_path}: {e}")
 
 
 class ModelRegistry:
@@ -132,12 +197,21 @@ class ModelRegistry:
     # ------------------------------------------------------------------
     def watch_snapshots(self, name: str, model_prefix: str, *,
                         poll_s: float = 5.0, start: bool = False,
+                        initial_iter: int = -1,
+                        state_file: Optional[str] = None,
                         **session_opts) -> None:
         """Watch ``<model_prefix>.snapshot_iter_<k>[.txt]`` files and
         promote new ones. Call :meth:`poll_snapshots` manually (tests,
         single-threaded serving loops) or pass ``start=True`` for a
-        background poller."""
-        w = _Watch(model_prefix, session_opts, poll_s)
+        background poller.
+
+        ``initial_iter`` seeds the already-served floor (e.g. the
+        iteration parsed from the snapshot the process booted on); the
+        floor persisted in ``state_file`` (default
+        ``<model_prefix>.watch_state.json``) is merged in, whichever is
+        higher wins."""
+        w = _Watch(model_prefix, session_opts, poll_s,
+                   initial_iter=initial_iter, state_file=state_file)
         with self._lock:
             self._watches[name] = w
         if start:
@@ -147,24 +221,48 @@ class ModelRegistry:
             w.thread.start()
 
     def poll_snapshots(self, name: str) -> Optional[int]:
-        """One poll: promote the newest unseen snapshot for `name`.
-        Returns the promoted iteration, or None if nothing new."""
+        """One poll: promote the newest unseen snapshot for `name` that
+        passes validation. Candidates are tried newest-first; one that
+        fails validation or promotion is marked rejected (and never
+        retried unless its file changes) while the old session keeps
+        serving. Returns the promoted iteration, or None."""
         with self._lock:
             w = self._watches.get(name)
         if w is None:
             return None
-        best_iter, best_path = w.last_iter, None
+        candidates = []
         for path in glob.glob(glob.escape(w.prefix) + ".snapshot_iter_*"):
             m = _SNAP_RE.search(path)
-            if m and int(m.group(1)) > best_iter:
-                best_iter, best_path = int(m.group(1)), path
-        if best_path is None:
-            return None
-        self.promote(name, best_path, **w.opts)
-        w.last_iter = best_iter
-        log_info(f"serving: picked up snapshot iter {best_iter} "
-                 f"({best_path})")
-        return best_iter
+            if m and int(m.group(1)) > w.last_iter:
+                candidates.append((int(m.group(1)), path))
+        for it, path in sorted(candidates, reverse=True):
+            try:
+                st = os.stat(path)
+                sig = (path, st.st_mtime_ns, st.st_size)
+            except OSError:
+                continue
+            if sig in w.rejected:
+                continue
+            ok, reason = _snapshot_valid(path)
+            if not ok:
+                w.rejected.add(sig)
+                self.metrics.inc("snapshots_rejected")
+                log_warning(f"serving: rejected snapshot {path}: {reason}; "
+                            "keeping the current session")
+                continue
+            try:
+                self.promote(name, path, **w.opts)
+            except Exception as e:
+                w.rejected.add(sig)
+                self.metrics.inc("snapshots_rejected")
+                log_warning(f"serving: snapshot {path} failed to load: "
+                            f"{e!r}; keeping the current session")
+                continue
+            w.last_iter = it
+            w.save_state()
+            log_info(f"serving: picked up snapshot iter {it} ({path})")
+            return it
+        return None
 
     def _watch_loop(self, name: str, w: _Watch) -> None:
         while not w.stop.wait(w.poll_s):

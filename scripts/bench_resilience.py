@@ -1,0 +1,124 @@
+"""Checkpointing overhead bench (docs/ROBUSTNESS.md).
+
+Measures what iteration-level checkpointing costs on the per-iteration
+training path it rides on: one plain ``Booster.update`` loop is the
+baseline, then the same loop with ``capture_trainer_state`` + an atomic
+``CheckpointManager.save`` every N iterations, for each N in
+RESIL_INTERVALS. Per arm this records the wall time, the number and
+mean latency of checkpoint writes, the serialized state size, and the
+overhead fraction vs the baseline; one timed ``load_latest`` +
+``restore_trainer_state`` round-trip is recorded as the resume cost.
+
+Writes ``BENCH_RESIL.json`` at the repo root (consumed by
+scripts/check_stale_claims.py). Also runnable as
+``BENCH_RESIL=1 python bench.py``.
+
+Env knobs: RESIL_ROWS (default 2000), RESIL_COLS (16), RESIL_ROUNDS
+(60), RESIL_INTERVALS ("10,50").
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _make_booster(X, y, params):
+    import lightgbm_tpu as lgb
+    ds = lgb.Dataset(X, label=y, params=params)
+    return lgb.Booster(params=params, train_set=ds)
+
+
+def main() -> None:
+    import numpy as np
+
+    import lightgbm_tpu as lgb  # noqa: F401  (path check before timing)
+    from lightgbm_tpu.runtime.checkpoint import (CheckpointManager,
+                                                 capture_trainer_state,
+                                                 restore_trainer_state)
+
+    n = int(os.environ.get("RESIL_ROWS", "2000"))
+    c = int(os.environ.get("RESIL_COLS", "16"))
+    rounds = int(os.environ.get("RESIL_ROUNDS", "60"))
+    intervals = [int(t) for t in
+                 os.environ.get("RESIL_INTERVALS", "10,50").split(",")]
+
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(n, c)).astype(np.float32)
+    y = (X[:, 0] + 0.3 * rng.normal(size=n) > 0).astype(np.float32)
+    params = dict(objective="binary", num_leaves=15, learning_rate=0.1,
+                  min_data_in_leaf=20, seed=7, verbose=-1,
+                  deterministic=True)
+
+    def run(interval, ckpt_dir):
+        booster = _make_booster(X, y, params)
+        mgr = (CheckpointManager(ckpt_dir, retention=3)
+               if interval > 0 else None)
+        booster.update()                # compile outside the timed loop
+        writes, t0 = [], time.perf_counter()
+        for _ in range(rounds):
+            booster.update()
+            g = booster._gbdt
+            if mgr is not None and g.iter % interval == 0:
+                tw = time.perf_counter()
+                state = capture_trainer_state(g)
+                path = mgr.save(state, g.iter)
+                writes.append(time.perf_counter() - tw)
+        # the measured unit is "train AND produce final model bytes":
+        # materializing host trees is lazy, and a checkpoint merely
+        # pulls it forward, so both arms must pay it inside the clock
+        # (it also drains jax's async dispatch queue)
+        booster.model_to_string()
+        wall = time.perf_counter() - t0
+        state_bytes = (os.path.getsize(path) if writes else 0)
+        return booster, wall, writes, state_bytes
+
+    results = {"rows": n, "cols": c, "rounds": rounds, "arms": {}}
+    work = tempfile.mkdtemp(prefix="bench_resil_")
+    try:
+        _, wall0, _, _ = run(0, "")
+        results["arms"]["interval_0"] = {"wall_s": round(wall0, 4)}
+        print(f"interval=0 (baseline): {wall0:.3f}s for {rounds} iters")
+
+        for iv in intervals:
+            d = os.path.join(work, f"iv{iv}")
+            booster, wall, writes, state_bytes = run(iv, d)
+            arm = {
+                "wall_s": round(wall, 4),
+                "n_checkpoints": len(writes),
+                "ckpt_write_s_mean": round(sum(writes) / len(writes), 5)
+                if writes else 0.0,
+                "state_bytes": state_bytes,
+                "overhead_frac": round(max(wall - wall0, 0.0) / wall0, 4),
+                "write_frac_of_wall": round(sum(writes) / wall, 4),
+            }
+            results["arms"][f"interval_{iv}"] = arm
+            print(f"interval={iv}: {wall:.3f}s, {len(writes)} ckpts "
+                  f"({arm['ckpt_write_s_mean'] * 1e3:.1f}ms each, "
+                  f"{state_bytes / 1e6:.2f}MB), overhead "
+                  f"{arm['overhead_frac']:.2%}")
+
+            if iv == intervals[-1]:
+                tr = time.perf_counter()
+                state = CheckpointManager(d).load_latest()
+                fresh = _make_booster(X, y, params)
+                fresh.update()
+                restore_trainer_state(fresh._gbdt, state)
+                results["restore_s"] = round(time.perf_counter() - tr, 4)
+                print(f"restore (load + rebuild): {results['restore_s']}s")
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    out = os.path.join(ROOT, "BENCH_RESIL.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=2, sort_keys=True)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
