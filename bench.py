@@ -133,11 +133,16 @@ def main() -> None:
     # BENCH_RESIL=1: checkpointing overhead vs a plain update loop
     # (scripts/bench_resilience.py, docs/ROBUSTNESS.md); writes
     # BENCH_RESIL.json
+    # BENCH_SLO=1: closed-loop overload bench, admission on vs off at
+    # ~5x capacity with a fault-injected slow scorer
+    # (scripts/bench_slo.py, docs/SERVING.md §Overload & SLOs); writes
+    # BENCH_SLO.json
     for env, script in (("BENCH_SERVING", "bench_serving.py"),
                         ("BENCH_ROWWISE", "bench_rowwise.py"),
                         ("BENCH_COMM", "bench_comm.py"),
                         ("BENCH_FUSED", "bench_fused.py"),
-                        ("BENCH_RESIL", "bench_resilience.py")):
+                        ("BENCH_RESIL", "bench_resilience.py"),
+                        ("BENCH_SLO", "bench_slo.py")):
         if os.environ.get(env, "") not in ("", "0"):
             import runpy
             runpy.run_path(

@@ -182,24 +182,55 @@ def _parse_rows(text: str) -> np.ndarray:
     return rows.reshape(1, -1) if rows.ndim == 1 else rows
 
 
-def build_http_server(cfg, registry, batcher, metrics):
-    """Minimal threaded HTTP front-end (POST /predict, GET /metrics,
-    GET /health). Factory so tests can bind port 0 and read back
-    `server.server_address`; `serve_forever` is the caller's call."""
+# one POST body may not exceed this many bytes (HTTP 413): bounds the
+# memory one client can pin before admission control even runs
+_MAX_BODY_BYTES = 32 << 20
+
+
+def build_http_server(cfg, registry, batcher, metrics,
+                      admission=None, breaker=None):
+    """Threaded HTTP front-end. Routes (docs/SERVING.md):
+
+      POST /predict  — score rows; overload protection maps to status
+                       codes: 429 (rate limited) / 503 (shed, queue
+                       full) with ``Retry-After``, 504 (deadline or
+                       timeout), 413 (oversize body), 400 (malformed)
+      GET /metrics   — serving summary JSON
+      GET /health    — legacy liveness (kept for old probes)
+      GET /healthz   — liveness: worker thread alive and not wedged
+      GET /readyz    — readiness: a model is registered and scoring is
+                       possible; body reports breaker/shedding state
+
+    A per-request deadline comes from the ``serve_deadline_header``
+    header (ms, overrides) or ``serve_deadline_ms`` (default budget);
+    clients are keyed for rate limiting by ``X-Client`` or their
+    address. Factory so tests can bind port 0 and read back
+    ``server.server_address``; ``serve_forever`` is the caller's call.
+    """
     import http.server
     import json
+    import math
+    import time as _time
 
-    from .serving import QueueFullError, RequestTimeout
+    from .serving import QueueFullError, RequestTimeout, ShedError
+
+    deadline_hdr = getattr(cfg, "serve_deadline_header", "") or "X-Deadline-Ms"
+    default_deadline_ms = float(getattr(cfg, "serve_deadline_ms", 0.0) or 0.0)
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def log_message(self, *args):   # keep serving stdout quiet
             pass
 
-        def _send(self, code: int, obj) -> None:
+        def _send(self, code: int, obj, retry_after_s: float = 0.0) -> None:
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if retry_after_s > 0.0:
+                # HTTP Retry-After is integer seconds; round UP so a
+                # compliant client never retries into the same shed
+                self.send_header("Retry-After",
+                                 str(max(int(math.ceil(retry_after_s)), 1)))
             self.end_headers()
             self.wfile.write(body)
 
@@ -209,19 +240,70 @@ def build_http_server(cfg, registry, batcher, metrics):
             elif self.path == "/health":
                 self._send(200, {"status": "ok",
                                  "models": registry.names()})
+            elif self.path == "/healthz":
+                wedged = batcher.wedged()
+                ok = batcher.alive() and not wedged
+                self._send(200 if ok else 503, {
+                    "status": "ok" if ok else "unhealthy",
+                    "worker_alive": batcher.alive(),
+                    "worker_wedged": wedged,
+                })
+            elif self.path == "/readyz":
+                models = registry.names()
+                ok = bool(models) and batcher.alive()
+                body = {"status": "ready" if ok else "not_ready",
+                        "models": models,
+                        "queue_depth": batcher.depth,
+                        "states": dict(metrics.states)}
+                if breaker is not None:
+                    body["breaker"] = breaker.to_dict()
+                # an OPEN breaker or active shedding still serves (host
+                # fallback / partial admission): degraded, not unready
+                self._send(200 if ok else 503, body)
             else:
                 self._send(404, {"error": f"no route {self.path}"})
+
+        def _deadline(self):
+            ms = self.headers.get(deadline_hdr)
+            ms = float(ms) if ms is not None else default_deadline_ms
+            if ms <= 0.0:
+                return None
+            return _time.perf_counter() + ms / 1e3
 
         def do_POST(self):
             if self.path != "/predict":
                 return self._send(404, {"error": f"no route {self.path}"})
             try:
                 n = int(self.headers.get("Content-Length", 0))
-                rows = _parse_rows(self.rfile.read(n).decode())
-                pred = np.asarray(batcher.predict(rows))
-                self._send(200, {"predictions": pred.tolist()})
+                if n > _MAX_BODY_BYTES:
+                    return self._send(413, {
+                        "error": f"request body {n} bytes exceeds the "
+                                 f"{_MAX_BODY_BYTES}-byte limit"})
+                raw = self.rfile.read(n).decode()
+                deadline = self._deadline()
+            except Exception as e:
+                return self._send(400, {"error": str(e)})
+            try:
+                rows = _parse_rows(raw)
+                if rows.size == 0 or rows.ndim != 2:
+                    raise ValueError("empty or non-rectangular row block")
+            except Exception as e:
+                return self._send(400, {"error": f"malformed body: {e}"})
+            client = self.headers.get("X-Client") or self.client_address[0]
+            try:
+                if admission is not None:
+                    pred = admission.predict(rows, client=client,
+                                             deadline=deadline)
+                else:
+                    pred = batcher.predict(rows, deadline=deadline)
+                self._send(200, {"predictions":
+                                 np.asarray(pred).tolist()})
+            except ShedError as e:
+                # 429 (rate limit) or 503 (overload) — never queued
+                self._send(e.http_status, {"error": str(e)},
+                           retry_after_s=e.retry_after_s)
             except QueueFullError as e:
-                self._send(503, {"error": str(e)})
+                self._send(503, {"error": str(e)}, retry_after_s=1.0)
             except RequestTimeout as e:
                 self._send(504, {"error": str(e)})
             except Exception as e:
@@ -237,14 +319,30 @@ def run_serve(params: Dict[str, Any], cfg) -> None:
     bit-identical to task=predict on the host engine); else stdin lines."""
     if not cfg.input_model:
         log_fatal("task=serve requires input_model")
-    from .serving import MicroBatcher, ModelRegistry, ServingMetrics
+    from .runtime.faults import active_plan
+    from .serving import (AdmissionController, CircuitBreaker,
+                          MicroBatcher, ModelRegistry, ServingMetrics)
     metrics = ServingMetrics(max_batch=cfg.serve_max_batch)
+    fault_plan = active_plan(cfg.fault_plan)
+    # the breaker guards the device scoring path; a host-only deployment
+    # has nothing to degrade from, so it only exists when the device
+    # engine is in play and at least one trip condition is enabled
+    breaker = None
+    if cfg.serve_engine in ("auto", "device") and (
+            cfg.serve_breaker_failures > 0
+            or cfg.serve_breaker_latency_slo_ms > 0.0):
+        breaker = CircuitBreaker(
+            failure_threshold=cfg.serve_breaker_failures,
+            latency_slo_ms=cfg.serve_breaker_latency_slo_ms,
+            latency_trips=cfg.serve_breaker_latency_trips,
+            cooldown_s=cfg.serve_breaker_cooldown_s, metrics=metrics)
     registry = ModelRegistry(
         metrics=metrics, engine=cfg.serve_engine,
         max_batch=cfg.serve_max_batch, min_bucket=cfg.serve_min_bucket,
         num_shards=cfg.serve_num_shards, warmup=cfg.serve_warmup,
         start_iteration=cfg.start_iteration_predict,
-        num_iteration=cfg.num_iteration_predict)
+        num_iteration=cfg.num_iteration_predict,
+        breaker=breaker, fault_plan=fault_plan)
     registry.register("default", cfg.input_model)
     if cfg.serve_watch:
         # when the process booted on a snapshot file, its iteration seeds
@@ -261,14 +359,30 @@ def run_serve(params: Dict[str, Any], cfg) -> None:
         lambda X: registry.predict(X, raw_score=cfg.predict_raw_score),
         max_batch=cfg.serve_max_batch, max_wait_ms=cfg.serve_batch_wait_ms,
         queue_depth=cfg.serve_queue_depth,
-        timeout_ms=cfg.serve_request_timeout_ms, metrics=metrics)
+        timeout_ms=cfg.serve_request_timeout_ms, metrics=metrics,
+        fault_plan=fault_plan)
     batcher.start()
+    # admission control only fronts the HTTP path: file/stdin modes are
+    # the caller's own rows — there is no one to shed for. With default
+    # knobs it is pure depth-watermark shedding (engage at 80% queue);
+    # rate limits and the latency watermark are opt-in
+    admission = None
+    if cfg.serve_port > 0:
+        admission = AdmissionController(
+            batcher, metrics=metrics,
+            rate_qps=cfg.serve_admission_rate_qps,
+            burst=cfg.serve_admission_burst,
+            queue_high=cfg.serve_admission_queue_high,
+            queue_low=cfg.serve_admission_queue_low,
+            p99_slo_ms=cfg.serve_admission_p99_slo_ms,
+            shed_class=cfg.serve_admission_shed_class)
     try:
         if cfg.serve_port > 0:
-            server = build_http_server(cfg, registry, batcher, metrics)
+            server = build_http_server(cfg, registry, batcher, metrics,
+                                       admission=admission, breaker=breaker)
             log_info(f"serving on http://{server.server_address[0]}:"
                      f"{server.server_address[1]} (POST /predict, "
-                     f"GET /metrics, GET /health)")
+                     f"GET /metrics /health /healthz /readyz)")
             try:
                 server.serve_forever()
             except KeyboardInterrupt:

@@ -153,6 +153,21 @@ _alias("serve_request_timeout_ms", "serve_timeout_ms")
 _alias("serve_num_shards", "serving_num_shards")
 _alias("serve_watch", "snapshot_watch", "watch_model")
 _alias("serve_metrics_output", "serve_metrics_out", "serving_metrics_file")
+_alias("serve_admission_rate_qps", "serve_rate_qps", "admission_rate_qps")
+_alias("serve_admission_burst", "serve_rate_burst", "admission_burst")
+_alias("serve_admission_queue_high", "admission_queue_high")
+_alias("serve_admission_queue_low", "admission_queue_low")
+_alias("serve_admission_p99_slo_ms", "serve_p99_slo_ms",
+       "admission_p99_slo_ms")
+_alias("serve_admission_shed_class", "serve_shed_class", "shed_class")
+_alias("serve_deadline_ms", "serve_default_deadline_ms",
+       "request_deadline_ms")
+_alias("serve_deadline_header", "deadline_header")
+_alias("serve_breaker_failures", "breaker_failures",
+       "serve_breaker_failure_threshold")
+_alias("serve_breaker_latency_slo_ms", "breaker_latency_slo_ms")
+_alias("serve_breaker_latency_trips", "breaker_latency_trips")
+_alias("serve_breaker_cooldown_s", "breaker_cooldown_s")
 _alias("checkpoint_interval", "checkpoint_freq", "ckpt_interval")
 _alias("checkpoint_dir", "checkpoint_path", "ckpt_dir")
 _alias("checkpoint_retention", "checkpoint_keep", "ckpt_retention")
@@ -313,6 +328,23 @@ class Config:
     serve_watch: str = ""              # model prefix to poll for snapshots
     serve_watch_poll_s: float = 5.0
     serve_metrics_output: str = ""     # write serving metrics JSON here
+    # overload protection (docs/SERVING.md §Overload & SLOs):
+    # admission control / load shedding in front of the micro-batcher
+    serve_admission_rate_qps: float = 0.0    # per-client rows/s; 0 = off
+    serve_admission_burst: float = 0.0       # bucket size; 0 = max(rate, 1)
+    serve_admission_queue_high: float = 0.8  # shed ENGAGE depth fraction
+    serve_admission_queue_low: float = 0.5   # shed DISENGAGE depth fraction
+    serve_admission_p99_slo_ms: float = 0.0  # shed when observed p99 > SLO
+    serve_admission_shed_class: str = "reject_new"  # | drop_oldest
+    # deadline propagation: default per-request budget (HTTP path), and
+    # the header a client uses to override it per request
+    serve_deadline_ms: float = 0.0           # 0 = no default deadline
+    serve_deadline_header: str = "X-Deadline-Ms"
+    # circuit breaker: device->host engine degradation
+    serve_breaker_failures: int = 3          # consecutive failures; 0 = off
+    serve_breaker_latency_slo_ms: float = 0.0  # per-batch SLO; 0 = off
+    serve_breaker_latency_trips: int = 3     # consecutive SLO misses
+    serve_breaker_cooldown_s: float = 5.0    # OPEN -> half-open probe delay
 
     # -- objective
     objective_seed: int = 5
@@ -513,6 +545,40 @@ class Config:
         if self.straggler_skew_threshold <= 1.0:
             log_fatal("straggler_skew_threshold should be > 1.0 (it is a "
                       "ratio over the median rank span)")
+        # serving overload-protection knobs fail fast at config time so a
+        # bad flag can't surface mid-traffic (docs/SERVING.md)
+        if self.serve_admission_shed_class not in ("reject_new",
+                                                   "drop_oldest"):
+            log_fatal(
+                "Unknown serve_admission_shed_class "
+                f"'{self.serve_admission_shed_class}' (supported: "
+                "'reject_new', 'drop_oldest'; docs/SERVING.md)")
+        if not (0.0 < self.serve_admission_queue_high <= 1.0):
+            log_fatal("serve_admission_queue_high should be in (0.0, 1.0]")
+        if not (0.0 < self.serve_admission_queue_low
+                <= self.serve_admission_queue_high):
+            log_fatal("serve_admission_queue_low should be in "
+                      "(0.0, serve_admission_queue_high]")
+        if self.serve_admission_rate_qps < 0.0 \
+                or self.serve_admission_burst < 0.0:
+            log_fatal("serve_admission_rate_qps / serve_admission_burst "
+                      "should be >= 0 (0 disables)")
+        if self.serve_admission_p99_slo_ms < 0.0:
+            log_fatal("serve_admission_p99_slo_ms should be >= 0 "
+                      "(0 disables the latency watermark)")
+        if self.serve_deadline_ms < 0.0:
+            log_fatal("serve_deadline_ms should be >= 0 (0 = no default "
+                      "request deadline)")
+        if self.serve_breaker_failures < 0:
+            log_fatal("serve_breaker_failures should be >= 0 (0 disables "
+                      "the consecutive-failure trip)")
+        if self.serve_breaker_latency_slo_ms < 0.0:
+            log_fatal("serve_breaker_latency_slo_ms should be >= 0 "
+                      "(0 disables the latency trip)")
+        if self.serve_breaker_latency_trips < 1:
+            log_fatal("serve_breaker_latency_trips should be >= 1")
+        if self.serve_breaker_cooldown_s <= 0.0:
+            log_fatal("serve_breaker_cooldown_s should be > 0")
 
     def max_depth_effective(self) -> int:
         return self.max_depth if self.max_depth > 0 else 10**9
@@ -528,7 +594,16 @@ class Config:
     _NON_MODEL_FIELDS = frozenset((
         "checkpoint_interval", "checkpoint_dir", "checkpoint_retention",
         "resume_from_checkpoint", "fault_plan", "step_max_retries",
-        "step_retry_backoff_s", "straggler_skew_threshold"))
+        "step_retry_backoff_s", "straggler_skew_threshold",
+        # serving overload-protection knobs describe the SERVING process,
+        # not the model; keeping them out preserves the byte-identical
+        # model-file contract across config changes
+        "serve_admission_rate_qps", "serve_admission_burst",
+        "serve_admission_queue_high", "serve_admission_queue_low",
+        "serve_admission_p99_slo_ms", "serve_admission_shed_class",
+        "serve_deadline_ms", "serve_deadline_header",
+        "serve_breaker_failures", "serve_breaker_latency_slo_ms",
+        "serve_breaker_latency_trips", "serve_breaker_cooldown_s"))
 
     def to_string(self) -> str:
         """Serialize `[key: value]` lines, the reference's Config::ToString

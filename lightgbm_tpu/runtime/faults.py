@@ -13,6 +13,21 @@ A fault PLAN is a ``;``/``,``-separated list of directives, each
                                   at iteration 2 (drives the watchdog's
                                   reduce_scatter -> allreduce degrade)
 
+Serving actions (serving/session.py, serving/batcher.py; keyed by the
+0-based scored-batch / worker-loop index instead of the training
+iteration — ``batch`` defaults to 0, i.e. "from the first batch"):
+
+    slow_score@batch=0:ms=50:times=8   sleep 50ms inside the timed
+                                  scoring region of 8 batches (drives
+                                  latency-SLO shedding and the circuit
+                                  breaker's latency trip)
+    fail_score@batch=0:times=3    the scorer raises InjectedFault for 3
+                                  batches (drives the breaker's
+                                  consecutive-failure device->host trip)
+    wedge_worker@batch=0:ms=800   the micro-batcher worker thread stalls
+                                  mid-loop (drives the /healthz wedge
+                                  detection; default ms is an hour)
+
 ``times`` defaults to 1 everywhere. Plans come from config
 ``fault_plan=...`` or the LIGHTGBM_TPU_FAULT_PLAN env var; with no plan
 the training hot path pays exactly one ``is None`` check per iteration.
@@ -28,7 +43,8 @@ from typing import Dict, List, Optional
 
 KILL_EXIT_CODE = 17
 
-_ACTIONS = ("kill", "raise", "sleep", "corrupt_snapshot", "fail_collective")
+_ACTIONS = ("kill", "raise", "sleep", "corrupt_snapshot", "fail_collective",
+            "slow_score", "fail_score", "wedge_worker")
 
 
 class InjectedFault(RuntimeError):
@@ -124,6 +140,38 @@ class FaultPlan:
                 d.remaining -= 1
                 raise CollectiveFault(
                     f"injected collective failure at iteration {it}")
+
+    def _consume_serving(self, action: str, idx: int) -> Optional[Dict]:
+        for d in self.directives:
+            if d.action == action and d.remaining > 0 \
+                    and int(idx) >= int(d.params.get("batch", 0)):
+                d.remaining -= 1
+                return d.params
+        return None
+
+    def slow_score(self, batch_idx: int) -> None:
+        """Scoring hook (serving/session.py score_margin), called inside
+        the timed region so the injected delay shows up in batch latency
+        (and so trips latency-SLO shedding / the breaker's SLO trip)."""
+        p = self._consume_serving("slow_score", batch_idx)
+        if p is not None:
+            time.sleep(float(p.get("ms", 100.0)) / 1e3)
+
+    def fail_score(self, batch_idx: int) -> None:
+        """Scoring hook: raise so the serving circuit breaker records a
+        protected-path failure (consecutive failures -> device->host)."""
+        if self._consume_serving("fail_score", batch_idx) is not None:
+            raise InjectedFault(
+                f"injected scoring failure at batch {batch_idx}")
+
+    def wedge_worker(self, loop_idx: int) -> None:
+        """Micro-batcher worker-loop hook: stall the worker thread so
+        its heartbeat goes stale while requests queue (the failure shape
+        /healthz wedge detection exists for). Default stall is an hour;
+        tests pass a small ``ms``."""
+        p = self._consume_serving("wedge_worker", loop_idx)
+        if p is not None:
+            time.sleep(float(p.get("ms", 3_600_000.0)) / 1e3)
 
     def should_corrupt_snapshot(self, iteration: int) -> bool:
         """Checkpoint-write hook (runtime/checkpoint.py); consumed once."""

@@ -1,14 +1,22 @@
 """Production inference engine over PackedModel (docs/SERVING.md).
 
- * session.py  — ServingSession: pinned packed trees, per-bucket compiled
-                 predictor cache, pow2 padding, warmup, sharded scoring
- * batcher.py  — MicroBatcher: coalesce concurrent small requests
- * registry.py — ModelRegistry: atomic hot-swap, snapshot watching
- * metrics.py  — ServingMetrics: QPS / p50 / p99 / occupancy / hit rate,
-                 exported through runtime/profiler JSON
+ * session.py   — ServingSession: pinned packed trees, per-bucket compiled
+                  predictor cache, pow2 padding, warmup, sharded scoring
+ * batcher.py   — MicroBatcher: coalesce concurrent small requests,
+                  deadline propagation, worker heartbeat
+ * admission.py — AdmissionController: per-client rate limits and
+                  watermark load shedding in front of the batcher
+ * breaker.py   — CircuitBreaker: device→host engine degradation with
+                  half-open recovery
+ * registry.py  — ModelRegistry: atomic hot-swap, snapshot watching
+ * metrics.py   — ServingMetrics: QPS / p50 / p99 / occupancy / hit rate,
+                  exported through runtime/profiler JSON
 """
 
+from .admission import (AdmissionController, OverloadedError,
+                        RateLimitedError, ShedError)
 from .batcher import MicroBatcher, QueueFullError, RequestTimeout
+from .breaker import CircuitBreaker
 from .metrics import ServingMetrics
 from .registry import ModelRegistry
 from .session import CompiledPredictorCache, ServingSession, bucket_for
@@ -16,5 +24,7 @@ from .session import CompiledPredictorCache, ServingSession, bucket_for
 __all__ = [
     "ServingSession", "CompiledPredictorCache", "bucket_for",
     "MicroBatcher", "QueueFullError", "RequestTimeout",
+    "AdmissionController", "ShedError", "RateLimitedError",
+    "OverloadedError", "CircuitBreaker",
     "ModelRegistry", "ServingMetrics",
 ]

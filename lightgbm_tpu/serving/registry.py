@@ -28,8 +28,10 @@ from __future__ import annotations
 import glob
 import json
 import os
+import random
 import re
 import threading
+import time
 from typing import Any, Dict, Optional, Tuple
 
 from ..utils.log import log_info, log_warning
@@ -43,6 +45,17 @@ _SNAP_RE = re.compile(r"\.snapshot_iter_(\d+)(?:\.txt)?$")
 # sentinel inside the last chunk is the cheap truncation probe
 _MODEL_EOF_MARKER = b"end of parameters"
 _EOF_PROBE_BYTES = 4096
+
+# exponential backoff for a snapshot path that keeps reappearing
+# invalid (a broken producer rewriting a torn snapshot every few
+# seconds): each fresh rejection doubles the pause before the next
+# validation attempt ON THAT PATH, up to the cap, with jitter so a
+# fleet of watchers does not re-probe in lockstep. Snapshots at other
+# paths are still validated immediately — a later, valid snapshot must
+# never wait behind a broken sibling. A successful promote resets the
+# streak.
+_BACKOFF_BASE_S = 0.5
+_BACKOFF_CAP_S = 60.0
 
 
 def _snapshot_valid(path: str) -> Tuple[bool, str]:
@@ -87,7 +100,8 @@ def _load_gbdt(model: Any):
 
 class _Watch:
     __slots__ = ("prefix", "opts", "last_iter", "poll_s", "thread", "stop",
-                 "state_path", "rejected")
+                 "state_path", "rejected", "reject_streak", "backoff_until",
+                 "last_rejected_path")
 
     def __init__(self, prefix: str, opts: Dict[str, Any], poll_s: float,
                  initial_iter: int = -1,
@@ -106,6 +120,26 @@ class _Watch:
         # snapshots that failed validation/promotion, keyed by
         # (path, mtime_ns, size): never retried unless rewritten
         self.rejected: set = set()
+        # consecutive polls that rejected a NEW (rewritten) candidate;
+        # drives the exponential validation backoff, scoped to the path
+        # that last failed (other snapshot files validate immediately)
+        self.reject_streak = 0
+        self.backoff_until = 0.0
+        self.last_rejected_path: Optional[str] = None
+
+    def note_rejection(self) -> float:
+        """A fresh (not previously-seen) candidate was rejected: extend
+        the backoff window and return its length in seconds."""
+        self.reject_streak += 1
+        pause = min(_BACKOFF_BASE_S * (2.0 ** (self.reject_streak - 1)),
+                    _BACKOFF_CAP_S) * (0.75 + 0.5 * random.random())
+        self.backoff_until = time.perf_counter() + pause
+        return pause
+
+    def note_promoted(self) -> None:
+        self.reject_streak = 0
+        self.backoff_until = 0.0
+        self.last_rejected_path = None
 
     def _load_state(self) -> int:
         try:
@@ -166,6 +200,12 @@ class ModelRegistry:
         for k in ("engine", "max_batch", "min_bucket", "num_shards"):
             opts.setdefault(k, getattr(
                 old, k if k != "engine" else "requested_engine"))
+        # the breaker (and any fault plan) is shared across versions so
+        # an OPEN device path stays degraded through a hot-swap instead
+        # of resetting to closed on every promote
+        for k in ("breaker", "fault_plan"):
+            if getattr(old, k, None) is not None:
+                opts.setdefault(k, getattr(old, k))
         sess = self._build(model, old.version + 1, opts)
         with self._lock:
             self._sessions[name] = sess
@@ -230,6 +270,7 @@ class ModelRegistry:
             w = self._watches.get(name)
         if w is None:
             return None
+        in_backoff = time.perf_counter() < w.backoff_until
         candidates = []
         for path in glob.glob(glob.escape(w.prefix) + ".snapshot_iter_*"):
             m = _SNAP_RE.search(path)
@@ -243,26 +284,43 @@ class ModelRegistry:
                 continue
             if sig in w.rejected:
                 continue
+            if in_backoff and path == w.last_rejected_path:
+                # rejection-backoff window: the path that last failed is
+                # skipped without re-validation (a broken producer
+                # rewriting the same torn snapshot gets exponentially
+                # rarer attention, not a warning per poll); any OTHER
+                # snapshot file still validates this poll
+                continue
             ok, reason = _snapshot_valid(path)
             if not ok:
-                w.rejected.add(sig)
-                self.metrics.inc("snapshots_rejected")
-                log_warning(f"serving: rejected snapshot {path}: {reason}; "
-                            "keeping the current session")
+                self._reject(w, sig, path, reason)
                 continue
             try:
                 self.promote(name, path, **w.opts)
             except Exception as e:
-                w.rejected.add(sig)
-                self.metrics.inc("snapshots_rejected")
-                log_warning(f"serving: snapshot {path} failed to load: "
-                            f"{e!r}; keeping the current session")
+                self._reject(w, sig, path, f"failed to load: {e!r}")
                 continue
             w.last_iter = it
             w.save_state()
+            w.note_promoted()
             log_info(f"serving: picked up snapshot iter {it} ({path})")
             return it
         return None
+
+    def _reject(self, w: _Watch, sig: Tuple, path: str,
+                reason: str) -> None:
+        """Remember a bad candidate and extend the poll backoff. The
+        FIRST rejection in a streak logs at warning; repeats (the same
+        producer rewriting the same broken file) drop to info so a
+        long-running serve process is not spammed once per rewrite."""
+        w.rejected.add(sig)
+        self.metrics.inc("snapshots_rejected")
+        w.last_rejected_path = path
+        pause = w.note_rejection()
+        log = log_warning if w.reject_streak == 1 else log_info
+        log(f"serving: rejected snapshot {path}: {reason}; keeping the "
+            f"current session (streak {w.reject_streak}, next validation "
+            f"attempt in {pause:.1f}s)")
 
     def _watch_loop(self, name: str, w: _Watch) -> None:
         while not w.stop.wait(w.poll_s):

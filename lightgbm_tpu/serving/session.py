@@ -89,8 +89,14 @@ class ServingSession:
                  num_shards: int = 0, start_iteration: int = 0,
                  num_iteration: int = -1, warmup: bool = False,
                  metrics: Optional[ServingMetrics] = None,
-                 version: int = 0) -> None:
+                 version: int = 0, breaker=None, fault_plan=None) -> None:
         self.gbdt = gbdt
+        # graceful-degradation circuit breaker (serving/breaker.py):
+        # guards the device scoring path; shared across hot-swapped
+        # session versions so the degrade decision survives promotes
+        self.breaker = breaker
+        self.fault_plan = fault_plan
+        self._n_scored = 0              # chunk counter for fault hooks
         self.version = int(version)
         K = gbdt.num_tree_per_iteration
         total_iters = len(gbdt.models) // max(K, 1)
@@ -236,9 +242,32 @@ class ServingSession:
     # ------------------------------------------------------------------
     # scoring
     # ------------------------------------------------------------------
+    def _host_fn(self, b: int):
+        return self._cache.get((self.version, "host", b),
+                               lambda b=b: self._pm.predict_margin)
+
+    def _score_device(self, X: np.ndarray, c0: int, c1: int,
+                      b: int) -> np.ndarray:
+        import jax
+        fn = self._cache.get((self.version, "device", b),
+                             lambda b=b: self._build_scorer(b))
+        m = c1 - c0
+        Xp = np.zeros((b, X.shape[1]), np.float32)
+        Xp[:m] = X[c0:c1]
+        return np.asarray(jax.device_get(fn(Xp)))[:, :m].astype(np.float64)
+
     def score_margin(self, X: np.ndarray) -> np.ndarray:
         """[K, n] f64 raw margins for X [n, F] (f64 in, any request
-        size: chunks of up to max_batch, each padded to its bucket)."""
+        size: chunks of up to max_batch, each padded to its bucket).
+
+        Engine degradation (docs/SERVING.md §Overload & SLOs): when a
+        circuit breaker is attached and the engine is ``device``, each
+        chunk first asks ``breaker.allow()`` — an OPEN breaker routes
+        the chunk through the host walk (bit-identical to
+        ``Booster.predict``, counted as ``host_fallbacks``) until a
+        half-open probe succeeds. A device failure mid-chunk is recorded
+        and the chunk is re-scored on the host, so a flaky device never
+        surfaces as a client error while the host path works."""
         X = np.ascontiguousarray(np.asarray(X, np.float64))
         n = X.shape[0]
         out = np.empty((self.K, n), np.float64)
@@ -246,20 +275,39 @@ class ServingSession:
             c1 = min(c0 + self.max_batch, n)
             m = c1 - c0
             b = bucket_for(m, self.min_bucket, self.max_batch)
-            fn = self._cache.get((self.version, self.engine, b),
-                                 lambda b=b: self._build_scorer(b))
+            seq, self._n_scored = self._n_scored, self._n_scored + 1
+            use_device = self.engine == "device"
+            if use_device and self.breaker is not None \
+                    and not self.breaker.allow():
+                use_device = False
+                self.metrics.inc("host_fallbacks")
             t0 = time.perf_counter()
-            if self.engine == "device":
-                import jax
-                Xp = np.zeros((b, X.shape[1]), np.float32)
-                Xp[:m] = X[c0:c1]
-                r = np.asarray(jax.device_get(fn(Xp)))[:, :m] \
-                    .astype(np.float64)
+            if self.fault_plan is not None:
+                # inside the timed region: the injected delay must show
+                # up in batch latency (latency-SLO shed / breaker trip)
+                self.fault_plan.slow_score(seq)
+            if use_device:
+                try:
+                    if self.fault_plan is not None:
+                        self.fault_plan.fail_score(seq)
+                    r = self._score_device(X, c0, c1, b)
+                    if self.breaker is not None:
+                        self.breaker.record_success(
+                            time.perf_counter() - t0)
+                except BaseException as e:
+                    if self.breaker is not None:
+                        self.breaker.record_failure(e)
+                    self.metrics.inc("host_fallbacks")
+                    log_warning(f"serving: device scoring failed "
+                                f"({e!r}); chunk re-scored on host")
+                    r = self._host_fn(b)(X[c0:c1])
             else:
+                if self.fault_plan is not None:
+                    self.fault_plan.fail_score(seq)
                 # host path scores the exact rows (padding buys nothing
                 # without a shaped trace) — bit-identical to
                 # Booster.predict by construction
-                r = fn(X[c0:c1])
+                r = self._host_fn(b)(X[c0:c1])
             self.metrics.record_batch(time.perf_counter() - t0, m)
             out[:, c0:c1] = r
         if self._avg_div:

@@ -1,0 +1,208 @@
+"""Closed-loop overload bench: admission control on vs off at ~5x capacity.
+
+A fault-injected slow scorer (``slow_score`` directive, runtime/faults.py)
+pins the per-batch service time, which fixes the system's capacity
+(max_batch / service_time rows/s). Paced load-generator threads then
+offer a multiple of that capacity; waiter threads collect completions.
+Two arms at the highest multiplier:
+
+ * ``no_admission`` — the bounded queue alone: every request is accepted
+   until the queue is full, so accepted-request latency grows with the
+   backlog and most of the budget is spent waiting;
+ * ``admission``    — AdmissionController with depth watermarks + p99
+   SLO shedding: overload is refused in O(1) at submit (503-style), and
+   the accepted requests keep a bounded p99.
+
+A shed-rate / accepted-p99 curve over offered-load multipliers (1x, 2x,
+5x by default) is recorded for the admission arm. Writes
+``BENCH_SLO.json`` at the repo root (consumed by
+scripts/check_stale_claims.py) and prints it; also runnable via
+``BENCH_SLO=1 python bench.py``.
+
+Env knobs: SLO_SERVICE_MS (injected per-batch service time),
+SLO_MAX_BATCH, SLO_QUEUE_DEPTH, SLO_CLIENTS, SLO_DURATION_S,
+SLO_MULTIPLIERS (comma list), SLO_P99_MS (the SLO).
+"""
+
+import json
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pct(vals, q):
+    if not vals:
+        return None
+    s = sorted(vals)
+    return round(s[min(len(s) - 1, int(round(q * (len(s) - 1))))] * 1e3, 2)
+
+
+def run_arm(booster, *, use_admission, offered_qps, duration_s,
+            service_ms, max_batch, queue_depth, p99_slo_ms, clients,
+            deadline_ms):
+    from lightgbm_tpu.runtime.faults import FaultPlan
+    from lightgbm_tpu.serving import (AdmissionController, MicroBatcher,
+                                      ServingMetrics, ServingSession,
+                                      ShedError)
+
+    metrics = ServingMetrics(max_batch=max_batch)
+    plan = FaultPlan.parse(
+        f"slow_score@batch=0:ms={service_ms}:times={10**9}")
+    sess = ServingSession.from_booster(
+        booster, engine="host", max_batch=max_batch, metrics=metrics,
+        fault_plan=plan)
+    mb = MicroBatcher(sess.predict, max_batch=max_batch, max_wait_ms=1.0,
+                      queue_depth=queue_depth, timeout_ms=4 * deadline_ms,
+                      metrics=metrics)
+    mb.start()
+    gate = AdmissionController(
+        mb, metrics=metrics, queue_high=0.5, queue_low=0.25,
+        p99_slo_ms=p99_slo_ms) if use_admission else None
+
+    row = np.zeros((1, booster._gbdt.max_feature_idx_ + 1))
+    accepted_lat, shed_lat = [], []
+    timeouts = [0]
+    lock = threading.Lock()
+    inflight: "queue.Queue" = queue.Queue()
+    gen_done = threading.Event()
+
+    def generator(rate_qps):
+        period = 1.0 / rate_qps
+        t_next = time.perf_counter()
+        t_end = t_next + duration_s
+        while (now := time.perf_counter()) < t_end:
+            if now < t_next:
+                time.sleep(t_next - now)
+            t_next += period
+            t0 = time.perf_counter()
+            deadline = t0 + deadline_ms / 1e3
+            try:
+                if gate is not None:
+                    req = gate.submit(row, deadline=deadline)
+                else:
+                    req = mb.submit(row, deadline=deadline)
+                inflight.put((req, t0))
+            except Exception:
+                # shed / rate-limited / queue-full: an immediate refusal
+                with lock:
+                    shed_lat.append(time.perf_counter() - t0)
+
+    def waiter():
+        while True:
+            try:
+                req, t0 = inflight.get(timeout=0.2)
+            except queue.Empty:
+                if gen_done.is_set():
+                    return
+                continue
+            try:
+                mb.wait(req)
+                with lock:
+                    accepted_lat.append(time.perf_counter() - t0)
+            except ShedError:
+                with lock:
+                    shed_lat.append(time.perf_counter() - t0)
+            except Exception:
+                with lock:
+                    timeouts[0] += 1
+
+    gens = [threading.Thread(target=generator, args=(offered_qps / clients,))
+            for _ in range(clients)]
+    waits = [threading.Thread(target=waiter) for _ in range(2 * clients)]
+    t0 = time.perf_counter()
+    for t in gens + waits:
+        t.start()
+    for t in gens:
+        t.join()
+    gen_done.set()
+    for t in waits:
+        t.join()
+    wall = time.perf_counter() - t0
+    mb.stop()
+
+    n_acc, n_shed = len(accepted_lat), len(shed_lat)
+    total = n_acc + n_shed + timeouts[0]
+    return {
+        "admission": bool(use_admission),
+        "offered_qps": round(offered_qps, 1),
+        "achieved_offer_qps": round(total / wall, 1) if wall else 0.0,
+        "accepted": n_acc,
+        "shed": n_shed,
+        "timeouts": timeouts[0],
+        "shed_rate": round(n_shed / total, 4) if total else 0.0,
+        "accepted_qps": round(n_acc / wall, 1) if wall else 0.0,
+        "accepted_p50_ms": _pct(accepted_lat, 0.50),
+        "accepted_p99_ms": _pct(accepted_lat, 0.99),
+        "shed_p99_ms": _pct(shed_lat, 0.99),
+        "expired": metrics.counters["expired"],
+    }
+
+
+def main() -> None:
+    service_ms = float(os.environ.get("SLO_SERVICE_MS", "20"))
+    max_batch = int(os.environ.get("SLO_MAX_BATCH", "8"))
+    queue_depth = int(os.environ.get("SLO_QUEUE_DEPTH", "64"))
+    clients = int(os.environ.get("SLO_CLIENTS", "8"))
+    duration_s = float(os.environ.get("SLO_DURATION_S", "2.0"))
+    p99_slo_ms = float(os.environ.get("SLO_P99_MS", "150"))
+    multipliers = [float(m) for m in os.environ.get(
+        "SLO_MULTIPLIERS", "1,2,5").split(",")]
+
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(7)
+    cols = 16
+    X = rng.normal(size=(4000, cols))
+    y = X @ rng.normal(size=cols) + 0.1 * rng.normal(size=4000)
+    booster = lgb.train(dict(objective="regression", num_leaves=31,
+                             verbose=-1),
+                        lgb.Dataset(X, label=y), num_boost_round=20)
+
+    # capacity: one batch of max_batch rows per (service + coalesce) tick
+    capacity_qps = max_batch / ((service_ms + 1.0) / 1e3)
+    deadline_ms = 2.0 * p99_slo_ms
+    arm = dict(service_ms=service_ms, max_batch=max_batch,
+               queue_depth=queue_depth, p99_slo_ms=p99_slo_ms,
+               clients=clients, duration_s=duration_s,
+               deadline_ms=deadline_ms)
+
+    curve = []
+    for m in multipliers:
+        r = run_arm(booster, use_admission=True,
+                    offered_qps=m * capacity_qps, **arm)
+        r["multiplier"] = m
+        curve.append(r)
+        print(f"# admission @ {m:g}x: shed_rate={r['shed_rate']}, "
+              f"accepted_p99={r['accepted_p99_ms']} ms", flush=True)
+
+    overload = max(multipliers)
+    baseline = run_arm(booster, use_admission=False,
+                       offered_qps=overload * capacity_qps, **arm)
+    print(f"# no_admission @ {overload:g}x: shed_rate="
+          f"{baseline['shed_rate']}, accepted_p99="
+          f"{baseline['accepted_p99_ms']} ms", flush=True)
+
+    results = {
+        "bench": "slo",
+        "service_ms": service_ms,
+        "max_batch": max_batch,
+        "capacity_qps_est": round(capacity_qps, 1),
+        "p99_slo_ms": p99_slo_ms,
+        "deadline_ms": deadline_ms,
+        "overload_multiplier": overload,
+        "admission_curve": curve,
+        "no_admission_at_overload": baseline,
+        "admission_at_overload": curve[-1],
+    }
+    out = os.path.join(ROOT, "BENCH_SLO.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=2, sort_keys=True)
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -27,6 +27,8 @@ import jax  # noqa: E402
 if not _ON_TPU:
     jax.config.update("jax_platforms", "cpu")
 
+import threading  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -34,3 +36,23 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)
+
+
+@pytest.fixture(autouse=True)
+def no_leaked_threads():
+    """Fail any test that leaves a NON-DAEMON thread running: a leaked
+    worker would hang interpreter shutdown (daemon threads — the serving
+    batcher, snapshot watchers, ThreadingHTTPServer handlers — are
+    allowed but are expected to be stopped by the test itself)."""
+    before = {t.ident for t in threading.enumerate()}
+    yield
+    leaked = [t for t in threading.enumerate()
+              if t.ident not in before and not t.daemon and t.is_alive()]
+    if leaked:
+        # give naturally-finishing threads a grace period before failing
+        deadline = 2.0 / max(len(leaked), 1)
+        for t in leaked:
+            t.join(timeout=deadline)
+        leaked = [t for t in leaked if t.is_alive()]
+    assert not leaked, (
+        f"test leaked non-daemon thread(s): {[t.name for t in leaked]}")

@@ -7,16 +7,17 @@ import json
 import threading
 import time
 import types
+import urllib.error
 import urllib.request
 
 import numpy as np
 import pytest
 
 import lightgbm_tpu as lgb
-from lightgbm_tpu.serving import (MicroBatcher, ModelRegistry,
-                                  QueueFullError, RequestTimeout,
-                                  ServingMetrics, ServingSession,
-                                  bucket_for)
+from lightgbm_tpu.serving import (AdmissionController, MicroBatcher,
+                                  ModelRegistry, QueueFullError,
+                                  RequestTimeout, ServingMetrics,
+                                  ServingSession, bucket_for)
 
 COLS = 12
 
@@ -370,3 +371,195 @@ def test_http_server_roundtrip(reg_booster):
             server.shutdown()
             server.server_close()
             t.join(timeout=5)
+
+
+# ----------------------------------------------------------------------
+# HTTP error paths (docs/SERVING.md §`task=serve`)
+# ----------------------------------------------------------------------
+def _http_server(reg, mb, metrics, admission=None, breaker=None,
+                 **cfg_extra):
+    from lightgbm_tpu.cli import build_http_server
+    cfg = types.SimpleNamespace(serve_host="127.0.0.1", serve_port=0,
+                                **cfg_extra)
+    server = build_http_server(cfg, reg, mb, metrics,
+                               admission=admission, breaker=breaker)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server, t
+
+
+def _req(host, port, path="/predict", body=None, headers=None, timeout=10):
+    """(status, parsed json body, headers dict) — errors included."""
+    r = urllib.request.Request(f"http://{host}:{port}{path}", data=body,
+                               headers=headers or {})
+    try:
+        with urllib.request.urlopen(r, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def test_http_malformed_oversize_and_404(reg_booster):
+    metrics = ServingMetrics(max_batch=32)
+    reg = ModelRegistry(metrics=metrics, engine="host", max_batch=32)
+    reg.register("default", reg_booster)
+    with MicroBatcher(lambda X: reg.predict(X), max_batch=32,
+                      max_wait_ms=1.0, metrics=metrics) as mb:
+        server, t = _http_server(reg, mb, metrics)
+        host, port = server.server_address
+        try:
+            code, body, _ = _req(host, port, body=b"{not json, not rows")
+            assert code == 400 and "error" in body
+            code, body, _ = _req(host, port, body=b"")
+            assert code == 400
+            code, body, _ = _req(host, port, path="/nope", body=b"[]")
+            assert code == 404
+            code, body, _ = _req(host, port, path="/nope")
+            assert code == 404
+            # oversize: declared Content-Length over the cap is refused
+            # BEFORE the body is read (no 32 MiB upload needed)
+            import http.client
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            conn.putrequest("POST", "/predict")
+            conn.putheader("Content-Length", str(64 << 20))
+            conn.endheaders()
+            assert conn.getresponse().status == 413
+            conn.close()
+        finally:
+            server.shutdown()
+            server.server_close()
+            t.join(timeout=5)
+
+
+def test_http_request_during_promote(reg_booster, reg_booster_v2):
+    """Hot-swap under live HTTP traffic: every response is a 200 from
+    either the old or the new version — never an error, never a mix
+    within one response."""
+    rng = np.random.RandomState(21)
+    metrics = ServingMetrics(max_batch=32)
+    reg = ModelRegistry(metrics=metrics, engine="host", max_batch=32)
+    reg.register("default", reg_booster)
+    rows = rng.normal(size=(2, COLS))
+    body = json.dumps({"rows": rows.tolist()}).encode()
+    old = reg_booster.predict(rows)
+    new = reg_booster_v2.predict(rows)
+    results = []
+    with MicroBatcher(lambda X: reg.predict(X), max_batch=32,
+                      max_wait_ms=0.5, metrics=metrics) as mb:
+        server, t = _http_server(reg, mb, metrics)
+        host, port = server.server_address
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                results.append(_req(host, port, body=body)[:2])
+
+        try:
+            threads = [threading.Thread(target=hammer) for _ in range(3)]
+            for th in threads:
+                th.start()
+            time.sleep(0.2)
+            reg.promote("default", reg_booster_v2)
+            time.sleep(0.2)
+        finally:
+            stop.set()
+            for th in threads:
+                th.join(timeout=5)
+            server.shutdown()
+            server.server_close()
+            t.join(timeout=5)
+    assert results
+    for code, resp in results:
+        assert code == 200
+        p = np.asarray(resp["predictions"])
+        assert np.array_equal(p, old) or np.array_equal(p, new)
+    assert reg.session("default").version == 1
+
+
+def test_http_rate_limit_429_retry_after(reg_booster):
+    metrics = ServingMetrics(max_batch=32)
+    reg = ModelRegistry(metrics=metrics, engine="host", max_batch=32)
+    reg.register("default", reg_booster)
+    body = json.dumps({"rows": [[0.0] * COLS]}).encode()
+    with MicroBatcher(lambda X: reg.predict(X), max_batch=32,
+                      max_wait_ms=1.0, metrics=metrics) as mb:
+        adm = AdmissionController(mb, metrics=metrics, rate_qps=1.0,
+                                  burst=1.0)
+        server, t = _http_server(reg, mb, metrics, admission=adm)
+        host, port = server.server_address
+        try:
+            code, _, _ = _req(host, port, body=body,
+                              headers={"X-Client": "alice"})
+            assert code == 200
+            code, resp, hdrs = _req(host, port, body=body,
+                                    headers={"X-Client": "alice"})
+            assert code == 429 and "rate-limited" in resp["error"]
+            assert int(hdrs["Retry-After"]) >= 1
+            # a DIFFERENT client is not rate-limited by alice's bucket
+            code, _, _ = _req(host, port, body=body,
+                              headers={"X-Client": "bob"})
+            assert code == 200
+        finally:
+            server.shutdown()
+            server.server_close()
+            t.join(timeout=5)
+    assert metrics.counters["shed_rate_limit"] == 1
+
+
+def test_http_overload_503_and_health_endpoints(reg_booster):
+    """Watermark shedding over HTTP: a wedged worker backs the queue
+    up, the next request gets an immediate 503 + Retry-After, /readyz
+    reports shedding, and /healthz flips to 503 once the worker dies."""
+    metrics = ServingMetrics(max_batch=8)
+    reg = ModelRegistry(metrics=metrics, engine="host", max_batch=8)
+    reg.register("default", reg_booster)
+    gate = threading.Event()
+
+    def gated(X):
+        gate.wait(10)
+        return reg.predict(X)
+
+    body = json.dumps({"rows": [[0.0] * COLS]}).encode()
+    mb = MicroBatcher(gated, max_batch=1, max_wait_ms=0.0,
+                      queue_depth=4, timeout_ms=15000, metrics=metrics)
+    mb.start()
+    adm = AdmissionController(mb, metrics=metrics,
+                              queue_high=0.5, queue_low=0.25)
+    server, t = _http_server(reg, mb, metrics, admission=adm)
+    host, port = server.server_address
+    try:
+        code, h, _ = _req(host, port, path="/healthz")
+        assert code == 200 and h["status"] == "ok"
+        code, r, _ = _req(host, port, path="/readyz")
+        assert code == 200 and r["status"] == "ready" \
+            and r["models"] == ["default"]
+        codes = []
+        posters = [threading.Thread(
+            target=lambda: codes.append(_req(host, port, body=body)[0]))
+            for _ in range(3)]
+        for th in posters:
+            th.start()
+        deadline = time.time() + 5
+        while mb.depth < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert mb.depth >= 2
+        code, resp, hdrs = _req(host, port, body=body)
+        assert code == 503 and "overloaded" in resp["error"]
+        assert int(hdrs["Retry-After"]) >= 1
+        code, r, _ = _req(host, port, path="/readyz")
+        assert r["states"].get("shedding") == "yes"
+        gate.set()
+        for th in posters:
+            th.join(timeout=10)
+        assert codes == [200, 200, 200]
+        # dead worker -> liveness failure
+        mb.stop()
+        code, h, _ = _req(host, port, path="/healthz")
+        assert code == 503 and h["worker_alive"] is False
+    finally:
+        gate.set()
+        mb.stop()
+        server.shutdown()
+        server.server_close()
+        t.join(timeout=5)
+    assert metrics.counters["shed_overload"] >= 1

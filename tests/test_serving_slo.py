@@ -1,0 +1,506 @@
+"""Overload protection (docs/SERVING.md §Overload & SLOs): admission
+control / load shedding, deadline propagation, circuit-breaker engine
+degradation, wedge detection, snapshot-rejection backoff, config knobs.
+All CPU-runnable tier-1; the device engine is explicitly requested so
+the breaker path runs on the CPU backend too."""
+
+import json
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import resolve_params
+from lightgbm_tpu.runtime.faults import FaultPlan, InjectedFault
+from lightgbm_tpu.serving import (AdmissionController, CircuitBreaker,
+                                  MicroBatcher, ModelRegistry,
+                                  OverloadedError, RateLimitedError,
+                                  RequestTimeout, ServingMetrics,
+                                  ServingSession)
+from lightgbm_tpu.serving.admission import _TokenBucket
+from lightgbm_tpu.serving.breaker import CLOSED, HALF_OPEN, OPEN
+
+COLS = 12
+
+
+def _make(rng, n=400, num_boost_round=10):
+    X = rng.normal(size=(n, COLS))
+    y = X[:, 0] * 2 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=n)
+    return lgb.train(dict(objective="regression", num_leaves=15,
+                          verbose=-1, min_data_in_leaf=5),
+                     lgb.Dataset(X, label=y),
+                     num_boost_round=num_boost_round)
+
+
+@pytest.fixture(scope="module")
+def booster():
+    return _make(np.random.RandomState(3))
+
+
+# ----------------------------------------------------------------------
+# admission: token bucket, hysteresis, shed classes
+# ----------------------------------------------------------------------
+def test_token_bucket_exact_refill():
+    b = _TokenBucket(rate=10.0, burst=2.0, now=0.0)
+    assert b.take(0.0) == 0.0 and b.take(0.0) == 0.0      # burst spent
+    wait = b.take(0.0)
+    assert wait == pytest.approx(0.1)                     # 1 token @ 10/s
+    assert b.take(0.05) == pytest.approx(0.05)            # half refilled
+    assert b.take(0.1) == 0.0                             # refilled
+    # multi-row requests take n tokens at once
+    assert b.take(10.0, n=2.0) == 0.0
+    assert b.take(10.0, n=2.0) == pytest.approx(0.2)
+
+
+class _FakeBatcher:
+    """Just enough surface for AdmissionController."""
+
+    def __init__(self, capacity=10):
+        self.depth = 0
+        self.capacity = capacity
+        self.max_batch = 4
+        self.dropped = []
+
+    def drop_oldest(self, error=None):
+        self.dropped.append(error)
+        return True
+
+    def submit(self, x, deadline=None):
+        return ("req", deadline)
+
+
+def test_watermark_hysteresis_engage_disengage():
+    t = [0.0]
+    fb = _FakeBatcher(capacity=10)
+    adm = AdmissionController(fb, queue_high=0.8, queue_low=0.3,
+                              clock=lambda: t[0])
+    fb.depth = 7
+    adm.admit()                                  # below high: admitted
+    fb.depth = 8                                 # at high watermark
+    with pytest.raises(OverloadedError):
+        adm.admit()
+    fb.depth = 5                                 # between low and high:
+    with pytest.raises(OverloadedError):
+        adm.admit()                              # hysteresis holds
+    fb.depth = 3                                 # at low: disengage
+    adm.admit()
+    assert not adm.shedding
+
+
+def test_p99_slo_shedding_with_sliding_window():
+    t = [0.0]
+    fb = _FakeBatcher(capacity=1000)             # depth never triggers
+    adm = AdmissionController(fb, p99_slo_ms=50.0, clock=lambda: t[0])
+    for _ in range(20):
+        adm.observe_latency(0.200)               # 200 ms >> 50 ms SLO
+    with pytest.raises(OverloadedError):
+        adm.admit()
+    assert adm.shedding
+    # stale spike ages out of the 5 s window -> p99 becomes None ->
+    # latency half of the hysteresis releases (depth already low)
+    t[0] += 10.0
+    adm.admit()
+    assert not adm.shedding
+
+
+def test_shed_class_drop_oldest_admits_fresh():
+    fb = _FakeBatcher(capacity=10)
+    m = ServingMetrics()
+    adm = AdmissionController(fb, metrics=m, queue_high=0.5,
+                              queue_low=0.1, shed_class="drop_oldest")
+    fb.depth = 6
+    adm.submit(np.zeros((1, 3)))                 # shed oldest, admit new
+    assert len(fb.dropped) == 1
+    assert isinstance(fb.dropped[0], OverloadedError)
+    assert m.counters["shed_drop_oldest"] == 1
+    assert m.counters["admitted"] == 1
+
+
+def test_admission_validation():
+    fb = _FakeBatcher()
+    with pytest.raises(ValueError):
+        AdmissionController(fb, shed_class="nope")
+    with pytest.raises(ValueError):
+        AdmissionController(fb, queue_high=1.5)
+    with pytest.raises(ValueError):
+        AdmissionController(fb, queue_high=0.5, queue_low=0.8)
+    with pytest.raises(ValueError):
+        AdmissionController(fb, rate_qps=-1.0)
+
+
+def test_rate_limit_per_client_keys():
+    m = ServingMetrics()
+    fb = _FakeBatcher(capacity=100)
+    t = [0.0]
+    adm = AdmissionController(fb, metrics=m, rate_qps=2.0, burst=1.0,
+                              clock=lambda: t[0])
+    adm.admit(client="a")
+    with pytest.raises(RateLimitedError) as ei:
+        adm.admit(client="a")
+    assert ei.value.retry_after_s == pytest.approx(0.5)   # 1 token @ 2/s
+    assert ei.value.http_status == 429
+    adm.admit(client="b")                        # separate bucket
+    t[0] += 0.5
+    adm.admit(client="a")                        # refilled
+
+
+# ----------------------------------------------------------------------
+# deadline propagation
+# ----------------------------------------------------------------------
+def test_deadline_expired_at_batch_assembly():
+    """A request whose deadline passed while queued is failed at gather
+    time — before padding or scoring — and counted as expired."""
+    m = ServingMetrics()
+    gate = threading.Event()
+    calls = []
+
+    def gated(X):
+        calls.append(X.shape[0])
+        gate.wait(10)
+        return np.asarray(X)[:, 0]
+
+    with MicroBatcher(gated, max_batch=4, max_wait_ms=0.0,
+                      timeout_ms=5000, metrics=m) as mb:
+        r1 = mb.submit(np.zeros((1, 3)))                  # occupies worker
+        while not calls:
+            time.sleep(0.005)
+        r2 = mb.submit(np.zeros((1, 3)),
+                       deadline=time.perf_counter() + 0.05)
+        time.sleep(0.15)                                  # r2 expires queued
+        gate.set()
+        mb.wait(r1)
+        with pytest.raises(RequestTimeout, match="deadline expired"):
+            mb.wait(r2, timeout=5.0)
+    assert m.counters["expired"] == 1
+    assert calls == [1, 1][:len(calls)]          # r2 never reached scoring
+
+
+def test_deadline_bounds_wait_and_none_is_legacy():
+    with MicroBatcher(lambda X: np.asarray(X)[:, 0], max_batch=4,
+                      timeout_ms=50.0) as mb:
+        # no deadline: configured timeout applies, request succeeds
+        assert mb.predict(np.zeros((1, 3))) is not None
+        # a deadline already in the past fails without scoring
+        with pytest.raises(RequestTimeout):
+            mb.predict(np.zeros((1, 3)),
+                       deadline=time.perf_counter() - 0.01)
+
+
+def test_drop_oldest_on_real_batcher():
+    gate = threading.Event()
+
+    def gated(X):
+        gate.wait(10)
+        return np.asarray(X)[:, 0]
+
+    mb = MicroBatcher(gated, max_batch=1, max_wait_ms=0.0,
+                      queue_depth=8, timeout_ms=5000)
+    mb.start()
+    try:
+        r1 = mb.submit(np.zeros((1, 3)))
+        time.sleep(0.05)                          # r1 into the worker
+        r2 = mb.submit(np.zeros((1, 3)))          # oldest queued
+        r3 = mb.submit(np.zeros((1, 3)))
+        assert mb.drop_oldest(OverloadedError("shed", retry_after_s=2.0))
+        gate.set()
+        mb.wait(r1)
+        mb.wait(r3)
+        with pytest.raises(OverloadedError):
+            mb.wait(r2)
+    finally:
+        gate.set()
+        mb.stop()
+
+
+# ----------------------------------------------------------------------
+# circuit breaker + engine degradation
+# ----------------------------------------------------------------------
+def test_breaker_latency_trip_and_half_open_reopen():
+    t = [0.0]
+    br = CircuitBreaker(failure_threshold=0, latency_slo_ms=10.0,
+                        latency_trips=2, cooldown_s=1.0,
+                        clock=lambda: t[0])
+    br.record_success(0.005)                     # under SLO
+    br.record_success(0.050)
+    assert br.state == CLOSED
+    br.record_success(0.050)                     # 2nd consecutive miss
+    assert br.state == OPEN and "latency SLO" in br.last_trip_reason
+    assert not br.allow()
+    t[0] += 1.5
+    assert br.allow() and br.state == HALF_OPEN
+    assert not br.allow()                        # single probe at a time
+    br.record_success(0.050)                     # probe ALSO slow
+    assert br.state == OPEN                      # reopened
+    t[0] += 1.5
+    assert br.allow()
+    br.record_success(0.001)
+    assert br.state == CLOSED and br.recoveries == 1 and br.trips == 2
+
+
+def test_breaker_validation():
+    with pytest.raises(ValueError):
+        CircuitBreaker(failure_threshold=-1)
+    with pytest.raises(ValueError):
+        CircuitBreaker(latency_trips=0)
+    with pytest.raises(ValueError):
+        CircuitBreaker(cooldown_s=0.0)
+
+
+def test_session_degrades_device_to_host_and_recovers(booster):
+    """Acceptance: injected device failures trip the breaker device ->
+    host (requests still answered, bit-identical to Booster.predict);
+    after cooldown a half-open probe restores the device engine."""
+    rng = np.random.RandomState(9)
+    Xq = rng.normal(size=(5, COLS))
+    want = booster.predict(Xq)
+    m = ServingMetrics()
+    br = CircuitBreaker(failure_threshold=3, cooldown_s=0.05, metrics=m)
+    plan = FaultPlan.parse("fail_score@batch=0:times=3")
+    sess = ServingSession.from_booster(
+        booster, engine="device", max_batch=32, metrics=m,
+        breaker=br, fault_plan=plan)
+    assert sess.engine == "device"
+    for _ in range(3):                           # 3 injected device fails
+        assert np.array_equal(sess.predict(Xq), want)   # host re-score
+    assert br.state == OPEN and br.trips == 1
+    assert m.counters["host_fallbacks"] == 3
+    assert m.counters["breaker_trips"] == 1
+    # OPEN: scored on host without touching the device path
+    assert np.array_equal(sess.predict(Xq), want)
+    assert m.counters["host_fallbacks"] == 4
+    time.sleep(0.06)                             # cooldown elapses
+    out = sess.predict(Xq)                       # half-open probe: succeeds
+    assert br.state == CLOSED and br.recoveries == 1
+    assert m.counters["breaker_recoveries"] == 1
+    assert np.allclose(out, want, rtol=1e-5, atol=1e-6)  # f32 device walk
+    assert m.states["breaker"] == "closed"
+
+
+def test_breaker_survives_hot_swap(booster):
+    m = ServingMetrics()
+    br = CircuitBreaker(failure_threshold=1, cooldown_s=60.0, metrics=m)
+    reg = ModelRegistry(metrics=m, engine="device", max_batch=32,
+                        breaker=br)
+    reg.register("default", booster)
+    br.record_failure(RuntimeError("injected"))
+    assert br.state == OPEN
+    reg.promote("default", _make(np.random.RandomState(4)))
+    new = reg.session("default")
+    assert new.version == 1
+    assert new.breaker is br                     # shared, still OPEN
+    assert br.state == OPEN
+
+
+# ----------------------------------------------------------------------
+# wedge detection
+# ----------------------------------------------------------------------
+def test_wedge_worker_fault_flips_wedged():
+    plan = FaultPlan.parse("wedge_worker@batch=0:ms=500")
+    mb = MicroBatcher(lambda X: np.asarray(X)[:, 0], max_batch=4,
+                      timeout_ms=5000, fault_plan=plan)
+    mb.start()
+    try:
+        time.sleep(0.05)                         # worker inside the wedge
+        r = mb.submit(np.zeros((1, 3)))
+        time.sleep(0.25)
+        assert mb.wedged(threshold_s=0.2)        # stale beat + queued work
+        assert mb.wait(r, timeout=5.0) is not None   # wedge ends, served
+        assert not mb.wedged(threshold_s=0.2)
+    finally:
+        mb.stop()
+
+
+# ----------------------------------------------------------------------
+# snapshot-rejection backoff (registry watcher)
+# ----------------------------------------------------------------------
+def test_snapshot_rejection_backoff_and_reset(booster, tmp_path):
+    prefix = str(tmp_path / "model.txt")
+    reg = ModelRegistry(engine="host", max_batch=32)
+    reg.register("default", booster)
+    reg.watch_snapshots("default", prefix)
+    w = reg._watches["default"]
+    bad = tmp_path / "model.txt.snapshot_iter_5.txt"
+    bad.write_text("truncated garbage")
+    assert reg.poll_snapshots("default") is None
+    assert w.reject_streak == 1
+    assert w.backoff_until > time.perf_counter()
+    # rewritten-but-still-bad file inside the backoff window: skipped
+    # without another validation attempt (no new rejection)
+    bad.write_text("still garbage, new mtime")
+    assert reg.poll_snapshots("default") is None
+    assert w.reject_streak == 1
+    # window over (forced): the rewrite is validated, streak grows
+    w.backoff_until = 0.0
+    assert reg.poll_snapshots("default") is None
+    assert w.reject_streak == 2
+    # a valid snapshot promotes and resets the streak
+    w.backoff_until = 0.0
+    good = tmp_path / "model.txt.snapshot_iter_7.txt"
+    booster.save_model(str(good))
+    assert reg.poll_snapshots("default") == 7
+    assert w.reject_streak == 0 and w.backoff_until == 0.0
+    assert reg.session("default").version == 1
+
+
+# ----------------------------------------------------------------------
+# config knobs
+# ----------------------------------------------------------------------
+def test_config_aliases_validation_and_model_echo():
+    cfg = resolve_params({"serve_rate_qps": 50, "shed_class": "drop_oldest",
+                          "breaker_failures": 5,
+                          "request_deadline_ms": 200})
+    assert cfg.serve_admission_rate_qps == 50.0
+    assert cfg.serve_admission_shed_class == "drop_oldest"
+    assert cfg.serve_breaker_failures == 5
+    assert cfg.serve_deadline_ms == 200.0
+    # orchestration knobs stay OUT of the model-file parameter echo
+    echo = cfg.to_string()
+    for field in ("serve_admission_rate_qps", "serve_breaker_failures",
+                  "serve_deadline_ms", "serve_admission_shed_class"):
+        assert field not in echo
+    for bad in ({"serve_admission_queue_low": 0.9,
+                 "serve_admission_queue_high": 0.5},
+                {"serve_admission_shed_class": "zap"},
+                {"serve_breaker_cooldown_s": 0.0},
+                {"serve_breaker_latency_trips": 0},
+                {"serve_deadline_ms": -1}):
+        with pytest.raises(Exception):
+            resolve_params(bad)
+
+
+# ----------------------------------------------------------------------
+# acceptance: overload end-to-end
+# ----------------------------------------------------------------------
+def test_overload_sheds_fast_and_keeps_accepted_p99(booster):
+    """Acceptance (ISSUE 9): fault-injected slow scorer at >= 5x
+    capacity; shed requests fail immediately (never queued), accepted
+    p99 stays under the SLO, every request resolves (no deadlocks), and
+    nothing leaks (conftest thread guard)."""
+    service_ms, max_batch, slo_ms = 20.0, 8, 150.0
+    m = ServingMetrics(max_batch=max_batch)
+    plan = FaultPlan.parse(f"slow_score@batch=0:ms={service_ms}:times=100000")
+    sess = ServingSession.from_booster(
+        booster, engine="host", max_batch=max_batch, metrics=m,
+        fault_plan=plan)
+    mb = MicroBatcher(sess.predict, max_batch=max_batch, max_wait_ms=1.0,
+                      queue_depth=64, timeout_ms=4000, metrics=m)
+    mb.start()
+    adm = AdmissionController(mb, metrics=m, queue_high=0.5,
+                              queue_low=0.25, p99_slo_ms=slo_ms)
+    capacity = max_batch / ((service_ms + 1.0) / 1e3)
+    offered = 5.0 * capacity
+    clients = 8
+    duration = 1.2
+    accepted, shed, failed = [], [], []
+    lock = threading.Lock()
+    row = np.zeros((1, COLS))
+    import queue as _q
+    inflight: "_q.Queue" = _q.Queue()
+    gen_done = threading.Event()
+
+    def client():
+        period = clients / offered
+        t_end = time.perf_counter() + duration
+        while time.perf_counter() < t_end:
+            t0 = time.perf_counter()
+            try:
+                inflight.put((adm.submit(
+                    row, deadline=t0 + 2 * slo_ms / 1e3), t0))
+            except OverloadedError:
+                with lock:
+                    shed.append(time.perf_counter() - t0)
+            time.sleep(max(0.0, period - (time.perf_counter() - t0)))
+
+    def waiter():
+        # concurrent collection: latency is measured at completion, not
+        # when a sequential client finally gets around to wait()ing
+        while True:
+            try:
+                req, t0 = inflight.get(timeout=0.2)
+            except _q.Empty:
+                if gen_done.is_set():
+                    return
+                continue
+            try:
+                mb.wait(req)
+                with lock:
+                    accepted.append(time.perf_counter() - t0)
+            except Exception as e:
+                with lock:
+                    failed.append(e)
+
+    gens = [threading.Thread(target=client) for _ in range(clients)]
+    waits = [threading.Thread(target=waiter) for _ in range(2 * clients)]
+    for t in gens + waits:
+        t.start()
+    for t in gens:
+        t.join(timeout=30)
+    gen_done.set()
+    for t in waits:
+        t.join(timeout=30)
+    assert all(not t.is_alive() for t in gens + waits)   # no deadlock
+    mb.stop()
+
+    total = len(accepted) + len(shed) + len(failed)
+    assert total > 0.5 * offered * duration              # load was offered
+    assert len(shed) > len(accepted)                     # >= 5x: mostly shed
+    assert m.counters["shed_overload"] == len(shed)
+    # shed requests fail in O(1): immediate, never queued/scored
+    assert max(shed) < 0.05
+    # accepted requests kept their SLO (wide margin for slow CI)
+    acc = sorted(accepted)
+    p99 = acc[min(len(acc) - 1, int(round(0.99 * (len(acc) - 1))))]
+    assert p99 * 1e3 <= 2 * slo_ms
+    # every request resolved one way; stragglers failed with a REAL
+    # error (deadline), not a hang
+    for e in failed:
+        assert isinstance(e, (RequestTimeout, OverloadedError))
+    assert m.counters["admitted"] == len(accepted) + len(failed)
+
+
+def test_http_deadline_expiry_504(booster):
+    """HTTP path: a request whose deadline header expires while queued
+    returns 504 (batcher expired it at assembly or wait)."""
+    from lightgbm_tpu.cli import build_http_server
+    m = ServingMetrics(max_batch=8)
+    reg = ModelRegistry(metrics=m, engine="host", max_batch=8)
+    reg.register("default", booster)
+    gate = threading.Event()
+
+    def gated(X):
+        gate.wait(10)
+        return reg.predict(X)
+
+    mb = MicroBatcher(gated, max_batch=1, max_wait_ms=0.0,
+                      timeout_ms=10000, metrics=m)
+    mb.start()
+    cfg = types.SimpleNamespace(serve_host="127.0.0.1", serve_port=0,
+                                serve_deadline_ms=0.0,
+                                serve_deadline_header="X-Deadline-Ms")
+    server = build_http_server(cfg, reg, mb, m)
+    host, port = server.server_address
+    st = threading.Thread(target=server.serve_forever, daemon=True)
+    st.start()
+    body = json.dumps({"rows": [[0.0] * COLS]}).encode()
+    try:
+        # occupy the worker so the deadline-carrying request queues
+        blocker = mb.submit(np.zeros((1, COLS)))
+        req = urllib.request.Request(
+            f"http://{host}:{port}/predict", data=body,
+            headers={"X-Deadline-Ms": "50"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 504
+        gate.set()
+        mb.wait(blocker)
+    finally:
+        gate.set()
+        mb.stop()
+        server.shutdown()
+        server.server_close()
+        st.join(timeout=5)
