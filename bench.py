@@ -137,12 +137,17 @@ def main() -> None:
     # ~5x capacity with a fault-injected slow scorer
     # (scripts/bench_slo.py, docs/SERVING.md §Overload & SLOs); writes
     # BENCH_SLO.json
+    # BENCH_ONLINE=1: online-loop bench, refresh latency + serving p99
+    # during hot-swap refreshes vs idle + refit-vs-continue cost ratio
+    # (scripts/bench_online.py, docs/ONLINE.md); writes
+    # BENCH_ONLINE.json
     for env, script in (("BENCH_SERVING", "bench_serving.py"),
                         ("BENCH_ROWWISE", "bench_rowwise.py"),
                         ("BENCH_COMM", "bench_comm.py"),
                         ("BENCH_FUSED", "bench_fused.py"),
                         ("BENCH_RESIL", "bench_resilience.py"),
-                        ("BENCH_SLO", "bench_slo.py")):
+                        ("BENCH_SLO", "bench_slo.py"),
+                        ("BENCH_ONLINE", "bench_online.py")):
         if os.environ.get(env, "") not in ("", "0"):
             import runpy
             runpy.run_path(

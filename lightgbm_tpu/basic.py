@@ -849,13 +849,15 @@ class Booster:
         }
 
     def refit(self, data, label, decay_rate: float = 0.9,
-              **kwargs) -> "Booster":
+              weight=None, **kwargs) -> "Booster":
         """Refit existing tree structures to new data, returning a NEW
         Booster (the original is unchanged, like the reference python
         Booster.refit; leaf math per GBDT::RefitTree, gbdt.cpp:200-228):
         each leaf value becomes decay_rate * old + (1 - decay_rate) * new,
         where `new` is the regularized leaf output of the new data's
-        gradients falling in that leaf."""
+        gradients falling in that leaf. ``weight`` scales per-row
+        gradients/hessians exactly as at train time (docs/PARITY.md
+        §Refit)."""
         data = _to_2d_numpy(data)
         new_booster = Booster(model_str=self.model_to_string())
         g = new_booster._gbdt
@@ -873,6 +875,8 @@ class Booster:
         from .data.dataset import Metadata
         md = Metadata(N)
         md.set_label(label)
+        if weight is not None:
+            md.set_weight(np.asarray(weight, np.float32).reshape(-1))
         g.objective.init(md, N)
         scores = np.zeros((K, N), dtype=np.float64)
         import jax.numpy as jnp
@@ -889,7 +893,8 @@ class Booster:
                 gg, hh = g.objective.get_gradients(
                     jnp.asarray(scores[0] if K == 1 else scores,
                                 jnp.float32),
-                    jnp.asarray(label), None)
+                    jnp.asarray(label),
+                    None if md.weight is None else jnp.asarray(md.weight))
                 grads = np.asarray(gg).reshape(K, N) \
                     if np.asarray(gg).ndim > 1 \
                     else np.asarray(gg).reshape(1, N)
@@ -918,6 +923,8 @@ class Booster:
                     # train time, expressed as raw column ids.
                     from .models.linear import fit_linear_models
                     Ftot = data.shape[1]
+                    # grads/hesss already carry the sample weight (the
+                    # objective applies it); in_bag stays all-ones here
                     out = fit_linear_models(
                         tree, np.asarray(data, np.float32),
                         leaf.astype(np.int32), grads[k], hesss[k],

@@ -3,8 +3,10 @@
 Mirrors the reference CLI (src/main.cpp + src/application/application.cpp):
 `lightgbm_tpu config=train.conf [key=value ...]` with
 task = train | predict | refit | save_binary | convert_model | serve
+     | online
 (serve is new here: the lightgbm_tpu/serving/ engine behind a CSV/stdin
-loop or a minimal HTTP front-end, docs/SERVING.md).
+loop or a minimal HTTP front-end, docs/SERVING.md; online is the
+stream -> refit/warm-continue -> hot-swap loop, docs/ONLINE.md).
 Config files are `key = value` lines with `#` comments
 (reference: Application::LoadParameters, application.cpp:54).
 """
@@ -427,6 +429,149 @@ def run_serve(params: Dict[str, Any], cfg) -> None:
                 f"Serving metrics saved to {cfg.serve_metrics_output}")
 
 
+def run_online(params: Dict[str, Any], cfg) -> None:
+    """task=online: stream -> refit/warm-continue -> publish
+    (lightgbm_tpu/online/, docs/ONLINE.md).
+
+    ``data=`` is the ORIGINAL training data: its frozen bin mappers bin
+    every streamed micro-batch (the loop never re-bins). The anchor
+    model comes from ``input_model=`` or, absent that, a one-shot
+    offline training run on ``data``. ``online_serve=true`` co-locates a
+    live serving session (registry + micro-batcher, the same wiring as
+    task=serve) that every published refresh hot-swaps with zero
+    downtime."""
+    if not cfg.online_source:
+        log_fatal("task=online requires online_source=<directory to "
+                  "tail or .npz trace>")
+    if not cfg.data:
+        log_fatal("task=online requires data= (the original training "
+                  "data; its frozen bin mappers bin the stream)")
+    from .online import OnlineTrainer, SnapshotPublisher, open_source
+    from .runtime.faults import active_plan
+    fault_plan = active_plan(cfg.fault_plan)
+
+    base_ds = _load_dataset_from_config(cfg, cfg.data)
+    base_ds.params = {**base_ds.params, **params}
+    base_ds.construct()
+    if cfg.input_model:
+        with open(cfg.input_model) as f:
+            base_model = f.read()
+    else:
+        log_info("task=online: no input_model; training the base model "
+                 f"offline on {cfg.data} first")
+        booster = engine_train(params, base_ds,
+                               num_boost_round=cfg.num_iterations)
+        booster.save_model(cfg.output_model)
+        base_model = booster.model_to_string()
+
+    profiler = None
+    if cfg.device_profile:
+        from .runtime.profiler import StageProfiler
+        profiler = StageProfiler()
+
+    # co-located serving: same stack as run_serve, sharing the process
+    # (and on TPU the device) with the refresh loop
+    metrics = registry = batcher = server = None
+    serve_thread = None
+    if cfg.online_serve:
+        from .serving import (AdmissionController, CircuitBreaker,
+                              MicroBatcher, ModelRegistry, ServingMetrics)
+        metrics = ServingMetrics(max_batch=cfg.serve_max_batch)
+        breaker = None
+        if cfg.serve_engine in ("auto", "device") and (
+                cfg.serve_breaker_failures > 0
+                or cfg.serve_breaker_latency_slo_ms > 0.0):
+            breaker = CircuitBreaker(
+                failure_threshold=cfg.serve_breaker_failures,
+                latency_slo_ms=cfg.serve_breaker_latency_slo_ms,
+                latency_trips=cfg.serve_breaker_latency_trips,
+                cooldown_s=cfg.serve_breaker_cooldown_s, metrics=metrics)
+        registry = ModelRegistry(
+            metrics=metrics, engine=cfg.serve_engine,
+            max_batch=cfg.serve_max_batch, min_bucket=cfg.serve_min_bucket,
+            num_shards=cfg.serve_num_shards, warmup=cfg.serve_warmup,
+            start_iteration=cfg.start_iteration_predict,
+            num_iteration=cfg.num_iteration_predict,
+            breaker=breaker, fault_plan=fault_plan, profiler=profiler)
+        registry.register("default", base_model)
+        if cfg.online_publish_mode == "files":
+            # file-only publication still hot-swaps the co-located
+            # session, through the registry's snapshot watcher
+            registry.watch_snapshots("default", cfg.output_model,
+                                     poll_s=cfg.serve_watch_poll_s,
+                                     start=True)
+        batcher = MicroBatcher(
+            lambda X: registry.predict(X, raw_score=cfg.predict_raw_score),
+            max_batch=cfg.serve_max_batch,
+            max_wait_ms=cfg.serve_batch_wait_ms,
+            queue_depth=cfg.serve_queue_depth,
+            timeout_ms=cfg.serve_request_timeout_ms, metrics=metrics,
+            fault_plan=fault_plan)
+        batcher.start()
+        if cfg.serve_port > 0:
+            import threading
+            admission = AdmissionController(
+                batcher, metrics=metrics,
+                rate_qps=cfg.serve_admission_rate_qps,
+                burst=cfg.serve_admission_burst,
+                queue_high=cfg.serve_admission_queue_high,
+                queue_low=cfg.serve_admission_queue_low,
+                p99_slo_ms=cfg.serve_admission_p99_slo_ms,
+                shed_class=cfg.serve_admission_shed_class,
+                occupancy_high=cfg.serve_admission_occupancy_high)
+            server = build_http_server(cfg, registry, batcher, metrics,
+                                       admission=admission,
+                                       breaker=breaker)
+            serve_thread = threading.Thread(target=server.serve_forever,
+                                            name="online-http",
+                                            daemon=True)
+            serve_thread.start()
+            log_info(f"online serving on http://"
+                     f"{server.server_address[0]}:"
+                     f"{server.server_address[1]}")
+
+    publisher = SnapshotPublisher(prefix=cfg.output_model,
+                                  mode=cfg.online_publish_mode,
+                                  registry=registry, model_name="default")
+    source = open_source(cfg.online_source, fault_plan=fault_plan)
+    trainer = OnlineTrainer(
+        params, base_model, base_ds, source, publisher,
+        profiler=profiler, fault_plan=fault_plan,
+        checkpoint_dir=cfg.checkpoint_dir,
+        checkpoint_retention=cfg.checkpoint_retention)
+    try:
+        summary = trainer.run()
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+            if serve_thread is not None:
+                serve_thread.join(timeout=5.0)
+        if batcher is not None:
+            batcher.stop()
+        if registry is not None:
+            registry.stop_watchers()
+        if metrics is not None and cfg.serve_metrics_output:
+            metrics.export_json(cfg.serve_metrics_output)
+            log_info(f"Serving metrics saved to "
+                     f"{cfg.serve_metrics_output}")
+    if publisher.last_iteration >= 0 and \
+            cfg.online_publish_mode in ("files", "both"):
+        # the newest snapshot doubles as the final output model, so
+        # task=predict input_model=<output_model> works directly
+        from .runtime.checkpoint import atomic_write_text
+        with open(publisher.snapshot_path(publisher.last_iteration)) as f:
+            atomic_write_text(cfg.output_model, f.read())
+    if profiler is not None:
+        text = profiler.export_json(cfg.profile_output)
+        if cfg.profile_output:
+            log_info(f"Online profile saved to {cfg.profile_output}")
+        else:
+            print(text)
+    import json
+    log_info("online loop finished: " + json.dumps(summary, sort_keys=True))
+
+
 def run_convert_model(params: Dict[str, Any], cfg) -> None:
     if not cfg.input_model:
         log_fatal("task=convert_model requires input_model")
@@ -452,6 +597,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         run_refit(params, cfg)
     elif task == "serve":
         run_serve(params, cfg)
+    elif task == "online":
+        run_online(params, cfg)
     elif task == "convert_model":
         run_convert_model(params, cfg)
     else:

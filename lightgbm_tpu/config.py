@@ -168,6 +168,20 @@ _alias("serve_breaker_failures", "breaker_failures",
 _alias("serve_breaker_latency_slo_ms", "breaker_latency_slo_ms")
 _alias("serve_breaker_latency_trips", "breaker_latency_trips")
 _alias("serve_breaker_cooldown_s", "breaker_cooldown_s")
+_alias("serve_admission_occupancy_high", "admission_occupancy_high",
+       "occupancy_high")
+_alias("online_source", "stream_source", "online_data")
+_alias("online_window_rows", "online_window", "window_rows")
+_alias("online_refresh_rows", "online_refit_rows", "refresh_rows")
+_alias("online_max_staleness_s", "online_staleness_s", "max_staleness_s")
+_alias("online_continue_every", "continue_every")
+_alias("online_continue_trees", "continue_trees", "online_new_trees")
+_alias("online_publish_mode", "publish_mode")
+_alias("online_max_batches", "max_stream_batches")
+_alias("online_idle_timeout_s", "online_idle_timeout",
+       "stream_idle_timeout_s")
+_alias("online_checkpoint_every", "online_ckpt_every")
+_alias("online_serve", "online_colocated_serving")
 _alias("checkpoint_interval", "checkpoint_freq", "ckpt_interval")
 _alias("checkpoint_dir", "checkpoint_path", "ckpt_dir")
 _alias("checkpoint_retention", "checkpoint_keep", "ckpt_retention")
@@ -345,6 +359,33 @@ class Config:
     serve_breaker_latency_slo_ms: float = 0.0  # per-batch SLO; 0 = off
     serve_breaker_latency_trips: int = 3     # consecutive SLO misses
     serve_breaker_cooldown_s: float = 5.0    # OPEN -> half-open probe delay
+    # occupancy-keyed shedding: engage when the live batch-occupancy
+    # fraction (profiler metric: mean rows per scored batch / max_batch)
+    # reaches this threshold — the device itself, not the queue, is the
+    # bottleneck. 0 disables (docs/SERVING.md §Overload & SLOs).
+    serve_admission_occupancy_high: float = 0.0
+
+    # -- online learning loop (task=online; lightgbm_tpu/online/,
+    # docs/ONLINE.md). The loop consumes micro-batches from
+    # online_source, maintains a bounded sliding window binned against
+    # the FROZEN base-model BinMapper, alternates Booster.refit leaf
+    # refreshes with warm-continued boosting, and publishes every
+    # refreshed snapshot atomically under <output_model>.snapshot_iter_*.
+    online_source: str = ""            # directory to tail, or a .npz trace
+    online_window_rows: int = 4096     # sliding training window bound
+    online_refresh_rows: int = 1024    # pending rows that trigger a refresh
+    online_max_staleness_s: float = 0.0  # also refresh when the oldest
+    #                                    pending batch is this old; 0 = off
+    online_continue_every: int = 4     # every k-th refresh warm-continues
+    #                                    (k new trees); 0 = refit-only
+    online_continue_trees: int = 5     # boosting rounds per continue
+    online_publish_mode: str = "files"  # files | direct | both
+    online_max_batches: int = 0        # stop after N batches; 0 = stream end
+    online_idle_timeout_s: float = 10.0  # stop after this long idle
+    online_checkpoint_every: int = 1   # refreshes between loop checkpoints
+    #                                    (active when checkpoint_dir is set)
+    online_serve: bool = False         # co-located ServingSession hot-swap
+    #                                    (direct promotion into a registry)
 
     # -- objective
     objective_seed: int = 5
@@ -579,6 +620,43 @@ class Config:
             log_fatal("serve_breaker_latency_trips should be >= 1")
         if self.serve_breaker_cooldown_s <= 0.0:
             log_fatal("serve_breaker_cooldown_s should be > 0")
+        if not (0.0 <= self.serve_admission_occupancy_high <= 1.0):
+            log_fatal("serve_admission_occupancy_high should be in "
+                      "[0.0, 1.0] (0 disables occupancy shedding)")
+        # online-loop knobs fail fast so a bad flag can't surface
+        # mid-stream (docs/ONLINE.md)
+        if self.online_window_rows < 1:
+            log_fatal("online_window_rows should be >= 1")
+        if self.online_refresh_rows < 1:
+            log_fatal("online_refresh_rows should be >= 1")
+        if self.online_refresh_rows > self.online_window_rows:
+            log_fatal("online_refresh_rows should be <= online_window_rows "
+                      "(a refresh can never see more rows than the window "
+                      "holds)")
+        if self.online_max_staleness_s < 0.0:
+            log_fatal("online_max_staleness_s should be >= 0 (0 disables "
+                      "the staleness trigger)")
+        if self.online_continue_every < 0:
+            log_fatal("online_continue_every should be >= 0 (0 = "
+                      "refit-only policy)")
+        if self.online_continue_trees < 1:
+            log_fatal("online_continue_trees should be >= 1")
+        if self.online_publish_mode not in ("files", "direct", "both"):
+            log_fatal(
+                f"Unknown online_publish_mode '{self.online_publish_mode}' "
+                "(supported: 'files', 'direct', 'both'; docs/ONLINE.md)")
+        if self.online_max_batches < 0:
+            log_fatal("online_max_batches should be >= 0 (0 = run to "
+                      "stream end)")
+        if self.online_idle_timeout_s <= 0.0:
+            log_fatal("online_idle_timeout_s should be > 0")
+        if self.online_checkpoint_every < 1:
+            log_fatal("online_checkpoint_every should be >= 1")
+        if self.online_publish_mode in ("direct", "both") \
+                and self.task == "online" and not self.online_serve:
+            log_fatal("online_publish_mode='" + self.online_publish_mode
+                      + "' promotes into a co-located serving registry; "
+                      "set online_serve=true (or publish_mode=files)")
 
     def max_depth_effective(self) -> int:
         return self.max_depth if self.max_depth > 0 else 10**9
@@ -603,7 +681,17 @@ class Config:
         "serve_admission_p99_slo_ms", "serve_admission_shed_class",
         "serve_deadline_ms", "serve_deadline_header",
         "serve_breaker_failures", "serve_breaker_latency_slo_ms",
-        "serve_breaker_latency_trips", "serve_breaker_cooldown_s"))
+        "serve_breaker_latency_trips", "serve_breaker_cooldown_s",
+        "serve_admission_occupancy_high",
+        # online-loop knobs describe the refresh ORCHESTRATION, not the
+        # model: every published snapshot must stay byte-identical to
+        # the offline one-shot refit/continue on the same data
+        # (tests/test_online.py md5 parity)
+        "online_source", "online_window_rows", "online_refresh_rows",
+        "online_max_staleness_s", "online_continue_every",
+        "online_continue_trees", "online_publish_mode",
+        "online_max_batches", "online_idle_timeout_s",
+        "online_checkpoint_every", "online_serve"))
 
     def to_string(self) -> str:
         """Serialize `[key: value]` lines, the reference's Config::ToString

@@ -175,6 +175,20 @@ class BinnedDataset:
             infos.append("none" if inner < 0 else self.mappers[inner].feature_info())
         return infos
 
+    def schema_signature(self) -> str:
+        """Stable digest of the binning schema — column count, feature
+        names and every mapper's bin layout (feature_infos encodes the
+        bin upper bounds). The online loop's bin-compat guard compares
+        this across checkpoints and resumed runs: data produced under a
+        different schema must be rejected, never silently re-binned
+        (docs/ONLINE.md)."""
+        import hashlib
+        h = hashlib.sha256()
+        h.update(f"{self.num_total_features}|{self.max_bin}".encode())
+        for name, info in zip(self.feature_names, self.feature_infos()):
+            h.update(f"|{name}:{info}".encode())
+        return h.hexdigest()
+
     def storage_num_bins(self) -> List[int]:
         """Per-STORAGE-COLUMN bin counts in storage order: EFB bundle
         columns count their packed width (1 shared default bin + each

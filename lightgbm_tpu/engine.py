@@ -152,6 +152,27 @@ def train(
     return booster
 
 
+def warm_continue(params: Dict[str, Any], X, label,
+                  num_boost_round: int, init_model: Union[str, Booster],
+                  reference: Dataset, weight=None) -> Booster:
+    """Boost ``num_boost_round`` MORE trees on raw rows binned against a
+    FROZEN reference Dataset's mappers (``Dataset.init_streaming`` /
+    ``push_rows`` — the rows are never re-binned, so the continued trees
+    split on exactly the base model's bin boundaries).
+
+    This is the warm-continuation primitive of the online loop
+    (online/trainer.py) and, deliberately, the same function the
+    offline parity baselines call: one code path, byte-identical
+    models for identical inputs (tests/test_online.py)."""
+    X = np.asarray(X, np.float64)
+    ds = Dataset(None, params=copy.deepcopy(params))
+    ds.init_streaming(X.shape[0], reference=reference)
+    ds.push_rows(X, label=label, weight=weight)
+    ds.mark_finished()
+    return train(copy.deepcopy(params), ds,
+                 num_boost_round=num_boost_round, init_model=init_model)
+
+
 class CVBooster:
     """Ensemble of per-fold boosters (reference: engine.py:356)."""
 

@@ -1,0 +1,31 @@
+"""Online learning subsystem (docs/ONLINE.md): micro-batch stream
+ingestion -> bounded-window refit / warm-continue -> zero-downtime
+snapshot publication.
+
+Three modules, one pipeline:
+
+ * :mod:`.source` — pull-based micro-batch sources (directory tail,
+   callable, replayable trace) with the bin-compat schema guard and the
+   stall/corrupt fault-injection points.
+ * :mod:`.trainer` — :class:`OnlineTrainer`: the sliding window, the
+   refresh policy engine (row-count + staleness triggers, every k-th
+   refresh warm-continues), checkpoint/resume, profiler spans.
+ * :mod:`.publisher` — :class:`SnapshotPublisher`: atomic snapshot
+   files the serving registry's watcher hot-swaps in, and/or in-process
+   direct promotion of a co-located ServingSession.
+
+Wired into the CLI as ``task=online`` (cli.py run_online).
+"""
+
+from .publisher import PUBLISH_MODES, SnapshotPublisher
+from .source import (BatchSource, CallableSource, DirectorySource,
+                     MicroBatch, SchemaDriftError, TraceSource,
+                     check_batch_schema, open_source, save_trace)
+from .trainer import ONLINE_STATE_KIND, OnlineTrainer
+
+__all__ = [
+    "BatchSource", "CallableSource", "DirectorySource", "MicroBatch",
+    "SchemaDriftError", "TraceSource", "check_batch_schema",
+    "open_source", "save_trace", "PUBLISH_MODES", "SnapshotPublisher",
+    "ONLINE_STATE_KIND", "OnlineTrainer",
+]

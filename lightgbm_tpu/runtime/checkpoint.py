@@ -18,6 +18,14 @@ and the manifest is written LAST, from the in-memory payload hash: a
 torn or corrupted payload fails its checksum and the loader falls back
 to the next-older checkpoint. Retention is bounded (newest N kept).
 
+The manager is state-shape agnostic: the online loop persists its own
+state dicts through the same machinery (``kind="online_loop"`` — anchor
+model, window arrays, policy counters, publish seq; online/trainer.py),
+keyed by publish seq instead of boosting iteration, with the same
+guarantee (a killed loop resumes to md5-identical published snapshots,
+docs/ONLINE.md). Loaders that share a ``checkpoint_dir`` across both
+uses tell the states apart by their ``kind`` field.
+
 This module is imported eagerly by ``runtime/__init__`` so it must stay
 stdlib+numpy at the top level; jax and the model classes are imported
 inside functions.

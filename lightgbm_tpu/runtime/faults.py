@@ -28,6 +28,17 @@ iteration — ``batch`` defaults to 0, i.e. "from the first batch"):
                                   mid-loop (drives the /healthz wedge
                                   detection; default ms is an hour)
 
+Online-loop actions (online/source.py; keyed by the 0-based micro-batch
+index, same ``batch``/``times`` grammar as the serving actions):
+
+    stall_source@batch=2:ms=400   the micro-batch source blocks 400ms
+                                  before yielding batch 2 (drives the
+                                  online trainer's staleness watchdog)
+    corrupt_batch@batch=1:times=2 the source mangles 2 batches starting
+                                  at batch 1 (extra column -> the
+                                  bin-compat guard rejects; the loop
+                                  must skip-and-log, not die)
+
 ``times`` defaults to 1 everywhere. Plans come from config
 ``fault_plan=...`` or the LIGHTGBM_TPU_FAULT_PLAN env var; with no plan
 the training hot path pays exactly one ``is None`` check per iteration.
@@ -44,7 +55,8 @@ from typing import Dict, List, Optional
 KILL_EXIT_CODE = 17
 
 _ACTIONS = ("kill", "raise", "sleep", "corrupt_snapshot", "fail_collective",
-            "slow_score", "fail_score", "wedge_worker")
+            "slow_score", "fail_score", "wedge_worker",
+            "stall_source", "corrupt_batch")
 
 
 class InjectedFault(RuntimeError):
@@ -172,6 +184,21 @@ class FaultPlan:
         p = self._consume_serving("wedge_worker", loop_idx)
         if p is not None:
             time.sleep(float(p.get("ms", 3_600_000.0)) / 1e3)
+
+    def stall_source(self, batch_idx: int) -> None:
+        """Online-source hook (online/source.py), called before a batch
+        is yielded: block so the stream goes quiet and the trainer's
+        staleness watchdog has something to watch. Default stall is an
+        hour; tests pass a small ``ms``."""
+        p = self._consume_serving("stall_source", batch_idx)
+        if p is not None:
+            time.sleep(float(p.get("ms", 3_600_000.0)) / 1e3)
+
+    def should_corrupt_batch(self, batch_idx: int) -> bool:
+        """Online-source hook: mangle the batch about to be yielded
+        (the source widens it by one column) so the trainer's bin-compat
+        guard rejects it — degradation policy is skip-and-log."""
+        return self._consume_serving("corrupt_batch", batch_idx) is not None
 
     def should_corrupt_snapshot(self, iteration: int) -> bool:
         """Checkpoint-write hook (runtime/checkpoint.py); consumed once."""

@@ -239,6 +239,23 @@ class StageProfiler:
     def add_counter(self, name: str, value: float) -> None:
         self.counters[name] = self.counters.get(name, 0.0) + value
 
+    HBM_SAMPLE_CAP = 4096
+
+    def sample_hbm(self, tag: str = "") -> Optional[int]:
+        """Record one HBM-watermark sample (train+serve coexistence
+        profiling, docs/ONLINE.md): appended to ``extras["hbm_watermark"]``
+        and folded into the run peak. ``peak_bytes`` is None where the
+        backend has no allocator stats (CPU) — the sample is still
+        recorded so the export shape is backend-independent."""
+        peak = _hbm_peak_bytes()
+        if peak is not None:
+            self.hbm_peak_bytes = max(self.hbm_peak_bytes or 0, peak)
+        samples = self.extras.setdefault("hbm_watermark", [])
+        if len(samples) < self.HBM_SAMPLE_CAP:
+            samples.append({"seq": len(samples), "tag": str(tag),
+                            "peak_bytes": peak})
+        return peak
+
     # -- straggler detection ----------------------------------------------
 
     def record_rank_spans(self, stage: str, spans,

@@ -13,9 +13,13 @@ that point, by policy:
  * **overload watermarks with hysteresis** — shedding ENGAGES when
    queue depth rises to ``queue_high`` × capacity OR the observed
    request p99 (over a sliding time window of completed requests)
-   exceeds ``p99_slo_ms``; it DISENGAGES only when depth has fallen to
+   exceeds ``p99_slo_ms`` OR the device-occupancy observer reports at
+   least ``occupancy_high`` (the profiler's batch-occupancy metric:
+   every scored batch full means the device itself, not the queue, is
+   the bottleneck); it DISENGAGES only when depth has fallen to
    ``queue_low`` × capacity AND the p99 has recovered below
-   ``p99_recovery`` × SLO — no flapping at the boundary.
+   ``p99_recovery`` × SLO AND occupancy has fallen back below the
+   recovery fraction of its threshold — no flapping at the boundary.
  * **shed classes** — while shedding, ``reject_new`` refuses the new
    request (:class:`OverloadedError`, HTTP 503, ``retry_after_s``
    estimated from the queue drain rate); ``drop_oldest`` admits the new
@@ -49,6 +53,10 @@ P99_RECOVERY = 0.8
 # a past latency spike cannot pin the controller in the shedding state
 # after the queue has drained
 P99_WINDOW_S = 5.0
+# occupancy recovery factor (the occupancy half of the hysteresis band):
+# while shedding, observed occupancy must fall below this fraction of
+# ``occupancy_high`` before admission reopens
+OCCUPANCY_RECOVERY = 0.9
 
 
 class ShedError(RuntimeError):
@@ -105,6 +113,7 @@ class AdmissionController:
                  burst: float = 0.0, queue_high: float = 0.8,
                  queue_low: float = 0.5, p99_slo_ms: float = 0.0,
                  shed_class: str = "reject_new",
+                 occupancy_high: float = 0.0, occupancy_observer=None,
                  clock=time.perf_counter) -> None:
         if shed_class not in SHED_CLASSES:
             raise ValueError(f"unknown shed_class {shed_class!r} "
@@ -115,6 +124,9 @@ class AdmissionController:
             raise ValueError("queue_low must be in (0, queue_high]")
         if rate_qps < 0.0 or burst < 0.0 or p99_slo_ms < 0.0:
             raise ValueError("rate_qps / burst / p99_slo_ms must be >= 0")
+        if not (0.0 <= occupancy_high <= 1.0):
+            raise ValueError("occupancy_high must be in [0, 1] "
+                             "(0 disables occupancy shedding)")
         self.batcher = batcher
         self.metrics = metrics
         self.rate_qps = float(rate_qps)
@@ -124,6 +136,15 @@ class AdmissionController:
         self.queue_low = float(queue_low)
         self.p99_slo_ms = float(p99_slo_ms)
         self.shed_class = shed_class
+        self.occupancy_high = float(occupancy_high)
+        # device saturation signal (ROADMAP item 2 leftover): a callable
+        # returning the live occupancy fraction or None — defaults to
+        # the shared metrics' batch_occupancy (mean rows per scored
+        # batch / max_batch)
+        if occupancy_observer is None and occupancy_high > 0.0 \
+                and metrics is not None:
+            occupancy_observer = metrics.batch_occupancy
+        self.occupancy_observer = occupancy_observer
         self._clock = clock
         self._lock = threading.Lock()
         self._buckets: Dict[str, _TokenBucket] = {}
@@ -169,13 +190,27 @@ class AdmissionController:
                 mean_s = sum(bl.buf) / len(bl.buf)
         return min(max(batches * (mean_s or 0.1), 0.1), 30.0)
 
+    def observed_occupancy(self) -> Optional[float]:
+        """Live device-occupancy fraction from the observer; None when
+        occupancy shedding is disabled or the observer has no signal
+        yet (then only depth + p99 apply)."""
+        if self.occupancy_high <= 0.0 or self.occupancy_observer is None:
+            return None
+        try:
+            occ = self.occupancy_observer()
+        except Exception:
+            return None
+        return None if occ is None else float(occ)
+
     def _update_shedding(self) -> bool:
         depth = self.batcher.depth
         cap = max(self.batcher.capacity, 1)
         p99 = self.observed_p99_ms() if self.p99_slo_ms > 0.0 else None
+        occ = self.observed_occupancy()
         if not self.shedding:
             if depth >= self.queue_high * cap or \
-                    (p99 is not None and p99 > self.p99_slo_ms):
+                    (p99 is not None and p99 > self.p99_slo_ms) or \
+                    (occ is not None and occ >= self.occupancy_high):
                 self.shedding = True
                 if self.metrics is not None:
                     self.metrics.set_state("shedding", "yes")
@@ -183,12 +218,16 @@ class AdmissionController:
                     f"serving admission: shedding ENGAGED (queue "
                     f"{depth}/{cap}, p99 "
                     f"{'n/a' if p99 is None else f'{p99:.1f}ms'}, "
+                    f"occupancy "
+                    f"{'n/a' if occ is None else f'{occ:.2f}'}, "
                     f"class={self.shed_class})")
         else:
             depth_ok = depth <= self.queue_low * cap
             p99_ok = (self.p99_slo_ms <= 0.0 or p99 is None
                       or p99 <= P99_RECOVERY * self.p99_slo_ms)
-            if depth_ok and p99_ok:
+            occ_ok = (occ is None
+                      or occ < OCCUPANCY_RECOVERY * self.occupancy_high)
+            if depth_ok and p99_ok and occ_ok:
                 self.shedding = False
                 if self.metrics is not None:
                     self.metrics.set_state("shedding", "no")

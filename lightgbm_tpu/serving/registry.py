@@ -200,10 +200,11 @@ class ModelRegistry:
         for k in ("engine", "max_batch", "min_bucket", "num_shards"):
             opts.setdefault(k, getattr(
                 old, k if k != "engine" else "requested_engine"))
-        # the breaker (and any fault plan) is shared across versions so
-        # an OPEN device path stays degraded through a hot-swap instead
-        # of resetting to closed on every promote
-        for k in ("breaker", "fault_plan"):
+        # the breaker (and any fault plan / coexistence profiler) is
+        # shared across versions so an OPEN device path stays degraded
+        # through a hot-swap instead of resetting to closed on every
+        # promote, and HBM sampling survives swaps
+        for k in ("breaker", "fault_plan", "profiler"):
             if getattr(old, k, None) is not None:
                 opts.setdefault(k, getattr(old, k))
         sess = self._build(model, old.version + 1, opts)
@@ -306,6 +307,19 @@ class ModelRegistry:
             log_info(f"serving: picked up snapshot iter {it} ({path})")
             return it
         return None
+
+    def note_published(self, name: str, iteration: int) -> None:
+        """An in-process publisher (online/publisher.py mode="both")
+        direct-promoted this iteration AND wrote its snapshot file: lift
+        the watcher's already-served floor so the next poll does not
+        re-promote the file copy of what is already live."""
+        with self._lock:
+            w = self._watches.get(name)
+        if w is None:
+            return
+        if int(iteration) > w.last_iter:
+            w.last_iter = int(iteration)
+            w.save_state()
 
     def _reject(self, w: _Watch, sig: Tuple, path: str,
                 reason: str) -> None:

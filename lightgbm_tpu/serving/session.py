@@ -89,13 +89,18 @@ class ServingSession:
                  num_shards: int = 0, start_iteration: int = 0,
                  num_iteration: int = -1, warmup: bool = False,
                  metrics: Optional[ServingMetrics] = None,
-                 version: int = 0, breaker=None, fault_plan=None) -> None:
+                 version: int = 0, breaker=None, fault_plan=None,
+                 profiler=None) -> None:
         self.gbdt = gbdt
         # graceful-degradation circuit breaker (serving/breaker.py):
         # guards the device scoring path; shared across hot-swapped
         # session versions so the degrade decision survives promotes
         self.breaker = breaker
         self.fault_plan = fault_plan
+        # opt-in HBM watermark sampling per scored chunk (StageProfiler
+        # .sample_hbm): how train+serve coexistence on one device is
+        # profiled (task=online, docs/ONLINE.md); None costs one check
+        self.profiler = profiler
         self._n_scored = 0              # chunk counter for fault hooks
         self.version = int(version)
         K = gbdt.num_tree_per_iteration
@@ -309,6 +314,8 @@ class ServingSession:
                 # Booster.predict by construction
                 r = self._host_fn(b)(X[c0:c1])
             self.metrics.record_batch(time.perf_counter() - t0, m)
+            if self.profiler is not None:
+                self.profiler.sample_hbm("serve_score")
             out[:, c0:c1] = r
         if self._avg_div:
             out /= self._avg_div

@@ -1,0 +1,183 @@
+"""Online-loop bench: refresh latency and serving interference.
+
+Serves a model through ModelRegistry -> MicroBatcher while the online
+loop (stream -> refit / warm-continue -> direct hot-swap) runs against
+the same registry, and measures:
+
+ * refresh latency — wall time of each refresh cycle (window refit or
+   warm-continue + publish), from the trainer's profiler iterations;
+ * serving p99 during refreshes vs an idle baseline on the same load —
+   the hot-swap interference cost the zero-downtime design is supposed
+   to keep small;
+ * refit-vs-continue cost ratio — mean seconds per warm-continue over
+   mean seconds per leaf refit, the number that justifies refit as the
+   cheap steady-state refresh (docs/ONLINE.md).
+
+Emits ONE JSON line and writes BENCH_ONLINE.json; also runnable via
+``BENCH_ONLINE=1 python bench.py``.
+
+Env knobs: ONLINE_ROWS/ONLINE_COLS/ONLINE_TREES (base model),
+ONLINE_BATCHES/ONLINE_BATCH_ROWS (stream), ONLINE_WINDOW,
+ONLINE_REFRESH, ONLINE_CONTINUE_EVERY/ONLINE_CONTINUE_TREES,
+ONLINE_CLIENTS, ONLINE_IDLE_S (idle-baseline duration).
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _p(v, q):
+    return float(np.percentile(np.asarray(v), q)) if v else 0.0
+
+
+def main() -> None:
+    rows = int(os.environ.get("ONLINE_ROWS", "6000"))
+    cols = int(os.environ.get("ONLINE_COLS", "16"))
+    trees = int(os.environ.get("ONLINE_TREES", "40"))
+    n_batches = int(os.environ.get("ONLINE_BATCHES", "6"))
+    batch_rows = int(os.environ.get("ONLINE_BATCH_ROWS", "1500"))
+    window = int(os.environ.get("ONLINE_WINDOW", "4000"))
+    refresh = int(os.environ.get("ONLINE_REFRESH", "1500"))
+    cont_every = int(os.environ.get("ONLINE_CONTINUE_EVERY", "2"))
+    cont_trees = int(os.environ.get("ONLINE_CONTINUE_TREES", "5"))
+    clients = int(os.environ.get("ONLINE_CLIENTS", "4"))
+    idle_s = float(os.environ.get("ONLINE_IDLE_S", "3.0"))
+
+    from lightgbm_tpu.basic import Dataset
+    from lightgbm_tpu.engine import train
+    from lightgbm_tpu.online import (OnlineTrainer, SnapshotPublisher,
+                                     TraceSource)
+    from lightgbm_tpu.runtime.profiler import StageProfiler
+    from lightgbm_tpu.serving import (MicroBatcher, ModelRegistry,
+                                      ServingMetrics)
+
+    params = dict(objective="binary", num_leaves=31, learning_rate=0.1,
+                  min_data_in_leaf=20, verbosity=-1, seed=7,
+                  deterministic=True)
+    rng = np.random.RandomState(7)
+    w_true = rng.normal(size=cols)
+
+    def make(n, seed):
+        r = np.random.RandomState(seed)
+        X = r.normal(size=(n, cols))
+        y = (X @ w_true + r.normal(scale=0.5, size=n) > 0).astype(
+            np.float64)
+        return X, y
+
+    Xb, yb = make(rows, 1)
+    base_ds = Dataset(Xb, label=yb, params=dict(params),
+                      free_raw_data=False)
+    base_model = train(dict(params), base_ds,
+                       num_boost_round=trees).model_to_string()
+    Xs, ys = make(n_batches * batch_rows, 2)
+
+    metrics = ServingMetrics(max_batch=256)
+    registry = ModelRegistry(metrics=metrics, engine="host",
+                             max_batch=256)
+    registry.register("default", base_model)
+    batcher = MicroBatcher(lambda q: registry.predict(q), max_batch=256,
+                           max_wait_ms=1.0, queue_depth=1024,
+                           timeout_ms=30_000, metrics=metrics)
+    batcher.start()
+
+    lat_lock = threading.Lock()
+    latencies = []          # (t_done, seconds) tuples
+    stop = threading.Event()
+    Q = Xs[:8]
+
+    def traffic():
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            batcher.predict(Q)
+            t1 = time.perf_counter()
+            with lat_lock:
+                latencies.append((t1, t1 - t0))
+
+    threads = [threading.Thread(target=traffic, name=f"bench-client-{i}")
+               for i in range(clients)]
+    for th in threads:
+        th.start()
+
+    try:
+        # -- idle baseline: traffic with no refreshes ------------------
+        time.sleep(idle_s)
+        with lat_lock:
+            idle_lat = [s for _, s in latencies]
+            latencies.clear()
+
+        # -- online loop: refreshes hot-swapping under the same load ---
+        profiler = StageProfiler()
+        op = dict(params, online_window_rows=window,
+                  online_refresh_rows=refresh,
+                  online_continue_every=cont_every,
+                  online_continue_trees=cont_trees, online_serve=True)
+        trainer = OnlineTrainer(
+            op, base_model, base_ds,
+            TraceSource((Xs, ys, None,
+                         [batch_rows] * n_batches)),
+            SnapshotPublisher(mode="direct", registry=registry),
+            profiler=profiler)
+        t0 = time.perf_counter()
+        summary = trainer.run()
+        loop_s = time.perf_counter() - t0
+        with lat_lock:
+            busy_lat = [s for td, s in latencies if td <= t0 + loop_s]
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=10)
+        batcher.stop()
+
+    prof = profiler.to_dict()
+    iters = prof.get("ring", [])    # per-refresh records (iter ring)
+    refresh_wall = [r["wall_s"] for r in iters]
+    refit_s = [r["stages_s"].get("online_refit", 0.0) for r in iters
+               if r["stages_s"].get("online_refit")]
+    cont_s = [r["stages_s"].get("online_continue", 0.0) for r in iters
+              if r["stages_s"].get("online_continue")]
+    mean_refit = float(np.mean(refit_s)) if refit_s else 0.0
+    mean_cont = float(np.mean(cont_s)) if cont_s else 0.0
+
+    results = {
+        "bench": "online",
+        "base_rows": rows, "cols": cols, "base_trees": trees,
+        "stream_batches": n_batches, "batch_rows": batch_rows,
+        "window_rows": window, "refresh_rows": refresh,
+        "continue_every": cont_every, "continue_trees": cont_trees,
+        "publishes": summary["publishes"],
+        "refits": summary["refits"],
+        "continues": summary["continues"],
+        "loop_s": round(loop_s, 3),
+        "refresh_latency_mean_s": round(float(np.mean(refresh_wall)), 4)
+        if refresh_wall else 0.0,
+        "refresh_latency_max_s": round(float(np.max(refresh_wall)), 4)
+        if refresh_wall else 0.0,
+        "refit_mean_s": round(mean_refit, 4),
+        "continue_mean_s": round(mean_cont, 4),
+        "continue_over_refit": round(mean_cont / mean_refit, 2)
+        if mean_refit > 0 else 0.0,
+        "serving_idle": {"requests": len(idle_lat),
+                         "p50_ms": round(_p(idle_lat, 50) * 1e3, 3),
+                         "p99_ms": round(_p(idle_lat, 99) * 1e3, 3)},
+        "serving_during_refresh": {
+            "requests": len(busy_lat),
+            "p50_ms": round(_p(busy_lat, 50) * 1e3, 3),
+            "p99_ms": round(_p(busy_lat, 99) * 1e3, 3)},
+        "p99_ratio_refresh_over_idle": round(
+            _p(busy_lat, 99) / _p(idle_lat, 99), 2)
+        if idle_lat and busy_lat and _p(idle_lat, 99) > 0 else 0.0,
+    }
+    out = os.path.join(ROOT, "BENCH_ONLINE.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=2, sort_keys=True)
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,604 @@
+"""Online learning subsystem suite (docs/ONLINE.md).
+
+The core contract under test is BYTE parity: every snapshot the online
+loop publishes must be md5-identical to an offline one-shot baseline on
+the same cumulative data — ``anchor.refit(window)`` for refit
+refreshes, ``engine.warm_continue`` for warm-continued ones — and a
+loop killed mid-cycle (``kill@iter=k``, hard ``os._exit`` in a
+subprocess) must resume from its checkpoint to the same published
+bytes. Around that: the bin-compat schema guard, refresh-policy
+triggers (row count + staleness watchdog), stalled/corrupt-source
+degradation, zero-downtime hot-swap under live traffic, refit decay
+math parity (docs/PARITY.md §Refit), and the ``task=online`` CLI.
+"""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.basic import Booster, Dataset
+from lightgbm_tpu.cli import main as cli_main
+from lightgbm_tpu.config import resolve_params
+from lightgbm_tpu.engine import train as engine_train
+from lightgbm_tpu.engine import warm_continue
+from lightgbm_tpu.online import (CallableSource, DirectorySource,
+                                 OnlineTrainer, SchemaDriftError,
+                                 SnapshotPublisher, TraceSource,
+                                 check_batch_schema, open_source,
+                                 save_trace)
+from lightgbm_tpu.runtime.checkpoint import verify_manifest
+from lightgbm_tpu.runtime.faults import FaultPlan
+from lightgbm_tpu.serving import (MicroBatcher, ModelRegistry,
+                                  ServingMetrics)
+from lightgbm_tpu.utils.log import FatalError
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_COLS = 5
+PARAMS = dict(objective="binary", num_leaves=7, min_data_in_leaf=5,
+              learning_rate=0.2, seed=3, verbosity=-1, deterministic=True)
+
+
+def _base_data(n=300, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, N_COLS)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float64)
+    return X, y
+
+
+def _stream_data(n=600, seed=1):
+    return _base_data(n, seed)
+
+
+def _md5_file(path):
+    with open(path, "rb") as f:
+        return hashlib.md5(f.read()).hexdigest()
+
+
+def _md5_text(text):
+    return hashlib.md5(text.encode()).hexdigest()
+
+
+@pytest.fixture(scope="module")
+def base():
+    """(params, base_dataset, base_model_text) shared by the module."""
+    X, y = _base_data()
+    ds = Dataset(X, label=y, params=dict(PARAMS), free_raw_data=False)
+    booster = engine_train(dict(PARAMS), ds, num_boost_round=8)
+    return dict(PARAMS), ds, booster.model_to_string()
+
+
+# ----------------------------------------------------------------------
+# sources + bin-compat guard
+# ----------------------------------------------------------------------
+def test_trace_source_slicing_and_seek(tmp_path):
+    X, y = _stream_data(100)
+    w = np.linspace(1.0, 2.0, 100)
+    path = str(tmp_path / "t.npz")
+    save_trace(path, X, y, weight=w, batch_sizes=[30, 30, 40])
+    src = TraceSource(path)
+    assert src.num_batches == 3
+    b0 = src.next_batch()
+    assert b0.seq == 0 and b0.num_rows == 30
+    np.testing.assert_array_equal(b0.X, X[:30])
+    np.testing.assert_array_equal(b0.weight, w[:30])
+    src.seek(2)
+    b2 = src.next_batch()
+    assert b2.seq == 2 and b2.num_rows == 40
+    np.testing.assert_array_equal(b2.y, y[60:])
+    assert src.next_batch() is None and src.exhausted
+    # uniform slicing when batch_sizes is absent
+    src2 = TraceSource((X, y, None, None), batch_rows=64)
+    assert src2.num_batches == 2
+    # open_source dispatch
+    assert isinstance(open_source(path), TraceSource)
+    with pytest.raises(FileNotFoundError):
+        open_source(str(tmp_path / "nope"))
+
+
+def test_directory_source_tails_in_order(tmp_path):
+    d = tmp_path / "drops"
+    d.mkdir()
+    X, y = _stream_data(60)
+    np.savez(d / "b_001.npz", X=X[:20], y=y[:20])
+    np.savetxt(d / "a_000.csv", np.column_stack([y[20:40], X[20:40]]),
+               delimiter=",")
+    src = DirectorySource(str(d))
+    first = src.next_batch()          # csv sorts first, label col 0
+    np.testing.assert_allclose(first.X, X[20:40])
+    np.testing.assert_allclose(first.y, y[20:40])
+    second = src.next_batch()
+    np.testing.assert_array_equal(second.X, X[:20])
+    assert src.next_batch(timeout_s=0.0) is None and not src.exhausted
+    np.savez(d / "c_002.npz", X=X[40:], y=y[40:])    # late arrival
+    third = src.next_batch()
+    np.testing.assert_array_equal(third.y, y[40:])
+
+
+def test_schema_guard_rejects_drift():
+    X, y = _stream_data(10)
+    check_batch_schema(X, y, N_COLS)                     # clean: passes
+    with pytest.raises(SchemaDriftError):
+        check_batch_schema(X[:, :3], y, N_COLS)          # missing columns
+    with pytest.raises(SchemaDriftError):
+        check_batch_schema(np.hstack([X, X[:, :1]]), y, N_COLS)  # extra
+    with pytest.raises(SchemaDriftError):
+        check_batch_schema(X, y[:5], N_COLS)             # row mismatch
+    ybad = y.copy()
+    ybad[3] = np.nan
+    with pytest.raises(SchemaDriftError):
+        check_batch_schema(X, ybad, N_COLS)              # non-finite label
+
+
+def test_trainer_skips_drifted_batches(tmp_path, base):
+    """corrupt_batch fault -> the guard rejects exactly that batch, the
+    loop publishes on the clean remainder (skip-and-log policy)."""
+    params, base_ds, base_txt = base
+    X, y = _stream_data(400)
+    trace = str(tmp_path / "s.npz")
+    save_trace(trace, X, y, batch_sizes=[100] * 4)
+    plan = FaultPlan.parse("corrupt_batch@batch=1")
+    op = dict(params, online_window_rows=300, online_refresh_rows=150,
+              online_continue_every=0)
+    t = OnlineTrainer(op, base_txt, base_ds,
+                      TraceSource(trace, fault_plan=plan),
+                      SnapshotPublisher(prefix=str(tmp_path / "m"),
+                                        mode="files"),
+                      fault_plan=plan)
+    s = t.run()
+    assert s["skipped_batches"] == 1
+    assert s["consumed_batches"] == 4
+    assert s["consumed_rows"] == 300          # batch 1's rows never enter
+    assert s["publishes"] >= 1
+
+
+def test_stalled_source_trips_staleness_watchdog(tmp_path, base):
+    """stall_source holds batch 1 back; the staleness trigger publishes
+    the already-ingested rows instead of waiting for the row threshold."""
+    params, base_ds, base_txt = base
+    X, y = _stream_data(100)
+    trace = str(tmp_path / "s.npz")
+    save_trace(trace, X, y, batch_sizes=[50, 50])
+    plan = FaultPlan.parse("stall_source@batch=1:ms=300")
+    op = dict(params, online_window_rows=500, online_refresh_rows=500,
+              online_max_staleness_s=0.1, online_continue_every=0)
+    t = OnlineTrainer(op, base_txt, base_ds,
+                      TraceSource(trace, fault_plan=plan),
+                      SnapshotPublisher(prefix=str(tmp_path / "m"),
+                                        mode="files"),
+                      fault_plan=plan)
+    s = t.run()
+    # the stall blocks the pull itself, so by the time batch 1 lands the
+    # oldest pending rows are >100ms old: the staleness trigger fires
+    # (100 rows is far below the 500-row threshold)
+    assert s["stale_refreshes"] == 1
+    assert s["publishes"] == 1
+    assert s["consumed_rows"] == 100
+
+
+def test_refresh_policy_row_trigger_counts(tmp_path, base):
+    params, base_ds, base_txt = base
+    X, y = _stream_data(600)
+    trace = str(tmp_path / "s.npz")
+    save_trace(trace, X, y, batch_sizes=[100] * 6)
+    op = dict(params, online_window_rows=400, online_refresh_rows=200,
+              online_continue_every=0)
+    t = OnlineTrainer(op, base_txt, base_ds, TraceSource(trace),
+                      SnapshotPublisher(prefix=str(tmp_path / "m"),
+                                        mode="files"))
+    s = t.run()
+    # 600 rows / 200-row trigger -> exactly 3 refreshes, all refits
+    assert s["publishes"] == 3 and s["refits"] == 3 and s["continues"] == 0
+    assert s["window_rows"] == 400            # bounded window held
+
+
+# ----------------------------------------------------------------------
+# acceptance: md5 parity of every published snapshot vs offline one-shot
+# ----------------------------------------------------------------------
+def test_published_snapshots_md5_match_offline_baselines(tmp_path, base):
+    """>= 3 refresh cycles mixing refit and warm-continue; every
+    published snapshot byte-identical to the offline arm on the same
+    cumulative window, weights included."""
+    params, base_ds, base_txt = base
+    X, y = _stream_data(600)
+    w = np.round(np.linspace(1.0, 3.0, 600), 3)
+    trace = str(tmp_path / "s.npz")
+    save_trace(trace, X, y, weight=w, batch_sizes=[100] * 6)
+    cap, refresh, k_every, k_trees = 400, 200, 3, 4
+    op = dict(params, online_window_rows=cap, online_refresh_rows=refresh,
+              online_continue_every=k_every, online_continue_trees=k_trees)
+    t = OnlineTrainer(op, base_txt, base_ds, TraceSource(trace),
+                      SnapshotPublisher(prefix=str(tmp_path / "m"),
+                                        mode="files"))
+    s = t.run()
+    assert s["publishes"] == 3 and s["continues"] == 1
+
+    anchor = base_txt
+    for k in range(1, 4):
+        lo = max(0, 200 * k - cap)
+        Xw, yw, ww = X[lo:200 * k], y[lo:200 * k], w[lo:200 * k]
+        if k % k_every == 0:
+            bst = warm_continue(dict(op), Xw, yw, num_boost_round=k_trees,
+                                init_model=Booster(model_str=anchor),
+                                reference=base_ds, weight=ww)
+            offline = bst.model_to_string()
+            anchor = offline
+        else:
+            offline = Booster(model_str=anchor).refit(
+                Xw, yw, decay_rate=0.9, weight=ww).model_to_string()
+        snap = str(tmp_path / f"m.snapshot_iter_{k}.txt")
+        ok, reason = verify_manifest(snap)
+        assert ok, reason
+        assert _md5_file(snap) == _md5_text(offline), \
+            f"snapshot {k} diverged from its offline baseline"
+
+
+def test_in_process_resume_republishes_identical_bytes(tmp_path, base):
+    params, base_ds, base_txt = base
+    X, y = _stream_data(600)
+    trace = str(tmp_path / "s.npz")
+    save_trace(trace, X, y, batch_sizes=[100] * 6)
+    op = dict(params, online_window_rows=400, online_refresh_rows=200,
+              online_continue_every=3, online_continue_trees=4)
+
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    OnlineTrainer(op, base_txt, base_ds, TraceSource(trace),
+                  SnapshotPublisher(prefix=str(ref_dir / "m"),
+                                    mode="files")).run()
+
+    got_dir = tmp_path / "got"
+    got_dir.mkdir()
+    ck = str(tmp_path / "ckpt")
+    s1 = OnlineTrainer(dict(op, online_max_batches=4), base_txt, base_ds,
+                       TraceSource(trace),
+                       SnapshotPublisher(prefix=str(got_dir / "m"),
+                                         mode="files"),
+                       checkpoint_dir=ck).run()
+    assert s1["publishes"] == 2
+    s2 = OnlineTrainer(op, base_txt, base_ds, TraceSource(trace),
+                       SnapshotPublisher(prefix=str(got_dir / "m"),
+                                         mode="files"),
+                       checkpoint_dir=ck).run()
+    assert s2["consumed_batches"] == 6       # resumed, not replayed
+    for k in (1, 2, 3):
+        assert _md5_file(str(got_dir / f"m.snapshot_iter_{k}.txt")) == \
+            _md5_file(str(ref_dir / f"m.snapshot_iter_{k}.txt"))
+
+
+_KILL_WORKER = """\
+import json, sys
+spec = json.load(open(sys.argv[1]))
+import numpy as np
+from lightgbm_tpu.basic import Dataset
+from lightgbm_tpu.online import OnlineTrainer, SnapshotPublisher, TraceSource
+from lightgbm_tpu.runtime.faults import active_plan
+with np.load(spec["base_npz"]) as z:
+    X, y = z["X"], z["y"]
+params = spec["params"]
+ds = Dataset(X, label=y, params=dict(params), free_raw_data=False)
+plan = active_plan(spec.get("fault_plan", ""))
+t = OnlineTrainer(params, spec["base_model"], ds,
+                  TraceSource(spec["trace"], fault_plan=plan),
+                  SnapshotPublisher(prefix=spec["prefix"], mode="files"),
+                  fault_plan=plan, checkpoint_dir=spec["ckpt"])
+t.run()
+"""
+
+
+def test_kill_mid_cycle_resumes_to_identical_published_bytes(tmp_path,
+                                                             base):
+    """Acceptance: kill@iter=2 hard-exits (rc 17) between publishes; the
+    resumed subprocess seeks the source past the checkpointed batches
+    and every snapshot matches the uninterrupted run byte for byte."""
+    params, base_ds, base_txt = base
+    Xb, yb = _base_data()
+    base_npz = str(tmp_path / "base.npz")
+    np.savez(base_npz, X=Xb, y=yb)
+    X, y = _stream_data(600)
+    trace = str(tmp_path / "s.npz")
+    save_trace(trace, X, y, batch_sizes=[100] * 6)
+    op = dict(params, online_window_rows=400, online_refresh_rows=200,
+              online_continue_every=3, online_continue_trees=4)
+
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    OnlineTrainer(op, base_txt, base_ds, TraceSource(trace),
+                  SnapshotPublisher(prefix=str(ref_dir / "m"),
+                                    mode="files")).run()
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(_KILL_WORKER)
+    got_dir = tmp_path / "got"
+    got_dir.mkdir()
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT, JAX_PLATFORMS="cpu")
+
+    def spawn(fault):
+        spec = {"base_npz": base_npz, "params": op, "trace": trace,
+                "base_model": base_txt, "prefix": str(got_dir / "m"),
+                "ckpt": str(tmp_path / "ckpt"), "fault_plan": fault}
+        sp = tmp_path / "spec.json"
+        sp.write_text(json.dumps(spec))
+        return subprocess.run([sys.executable, str(worker), str(sp)],
+                              env=env, capture_output=True, text=True,
+                              timeout=600)
+
+    killed = spawn("kill@iter=2")
+    assert killed.returncode == 17, killed.stdout + killed.stderr
+    assert os.path.exists(str(got_dir / "m.snapshot_iter_1.txt"))
+    resumed = spawn("")
+    assert resumed.returncode == 0, resumed.stdout + resumed.stderr
+    for k in (1, 2, 3):
+        assert _md5_file(str(got_dir / f"m.snapshot_iter_{k}.txt")) == \
+            _md5_file(str(ref_dir / f"m.snapshot_iter_{k}.txt")), \
+            f"snapshot {k} diverged after kill/resume"
+
+
+# ----------------------------------------------------------------------
+# zero-downtime hot-swap under live traffic
+# ----------------------------------------------------------------------
+def test_hot_swap_under_live_traffic(tmp_path, base):
+    """Acceptance: >= 3 refresh cycles direct-promoted into a co-located
+    registry while a traffic thread scores continuously — zero request
+    errors, every prediction finite, version strictly advances."""
+    params, base_ds, base_txt = base
+    X, y = _stream_data(600)
+    trace = str(tmp_path / "s.npz")
+    save_trace(trace, X, y, batch_sizes=[100] * 6)
+
+    metrics = ServingMetrics(max_batch=64)
+    registry = ModelRegistry(metrics=metrics, engine="host", max_batch=64)
+    registry.register("default", base_txt)
+    batcher = MicroBatcher(lambda q: registry.predict(q), max_batch=64,
+                           max_wait_ms=1.0, queue_depth=64,
+                           timeout_ms=10_000, metrics=metrics)
+    batcher.start()
+
+    errors, n_preds = [], [0]
+    stop = threading.Event()
+    Xq = X[:8]
+
+    def traffic():
+        while not stop.is_set():
+            try:
+                p = np.asarray(batcher.predict(Xq))
+                assert np.all(np.isfinite(p))
+                n_preds[0] += 1
+            except Exception as e:           # pragma: no cover - fails test
+                errors.append(e)
+                return
+
+    th = threading.Thread(target=traffic, name="online-traffic")
+    th.start()
+    try:
+        op = dict(params, online_window_rows=400, online_refresh_rows=200,
+                  online_continue_every=3, online_continue_trees=4,
+                  online_serve=True)
+        pub = SnapshotPublisher(prefix=str(tmp_path / "m"), mode="both",
+                                registry=registry)
+        s = OnlineTrainer(op, base_txt, base_ds, TraceSource(trace),
+                          pub).run()
+    finally:
+        stop.set()
+        th.join(timeout=10)
+        batcher.stop()
+    assert not errors, errors
+    assert s["publishes"] >= 3
+    assert registry.session("default").version >= 3
+    assert metrics.counters.get("swaps", 0) >= 3
+    assert n_preds[0] > 0                    # traffic actually flowed
+
+
+def test_publisher_files_mode_and_watch_floor(tmp_path, base):
+    """'both' mode lifts the snapshot watcher's already-served floor so
+    the file copy of a direct-promoted model is never re-promoted."""
+    params, base_ds, base_txt = base
+    registry = ModelRegistry(engine="host", max_batch=64)
+    registry.register("default", base_txt)
+    prefix = str(tmp_path / "m")
+    registry.watch_snapshots("default", prefix, start=False)
+    pub = SnapshotPublisher(prefix=prefix, mode="both", registry=registry)
+    info = pub.publish(base_txt, 1)
+    assert info["promoted"] and os.path.exists(info["path"])
+    ok, reason = verify_manifest(info["path"])
+    assert ok, reason
+    v = registry.session("default").version
+    registry.poll_snapshots("default")
+    assert registry.session("default").version == v   # floor was lifted
+    # mode validation
+    with pytest.raises(ValueError):
+        SnapshotPublisher(prefix=prefix, mode="bogus")
+    with pytest.raises(ValueError):
+        SnapshotPublisher(prefix="", mode="files")
+    with pytest.raises(ValueError):
+        SnapshotPublisher(prefix=prefix, mode="direct", registry=None)
+
+
+# ----------------------------------------------------------------------
+# refit decay math parity (docs/PARITY.md §Refit)
+# ----------------------------------------------------------------------
+def _raw(model_text, X):
+    return np.asarray(Booster(model_str=model_text).predict(
+        X, raw_score=True))
+
+
+@pytest.mark.parametrize("objective,extra", [
+    ("binary", {}),
+    ("multiclass", {"num_class": 3}),
+])
+def test_refit_decay_blend_linearity_single_round(objective, extra):
+    """new_leaf = decay*old + (1-decay)*fresh. With a single boosting
+    round the fresh leaf outputs are computed from gradients at score 0
+    regardless of decay, so raw scores are exactly linear in decay.
+    (Multi-round refit is deliberately NOT linear: gradients are
+    recomputed per iteration from the already-refitted scores, matching
+    reference GBDT::RefitTree calling Boosting() each iteration — see
+    docs/PARITY.md §Refit.) Multiclass exercises the K>1 pred_leaf
+    reshape."""
+    rng = np.random.RandomState(7)
+    X = rng.rand(240, N_COLS)
+    y = (rng.randint(0, extra.get("num_class", 2), 240)
+         if objective == "multiclass"
+         else (X[:, 0] > 0.5).astype(float))
+    p = dict(PARAMS, objective=objective, **extra)
+    b = engine_train(dict(p), Dataset(X, label=y, params=dict(p)),
+                     num_boost_round=1)
+    X2 = rng.rand(240, N_COLS)
+    y2 = (rng.randint(0, extra.get("num_class", 2), 240)
+          if objective == "multiclass"
+          else (X2[:, 1] > 0.5).astype(float))
+    r0 = _raw(b.refit(X2, y2, decay_rate=0.0).model_to_string(), X)
+    r1 = _raw(b.refit(X2, y2, decay_rate=1.0).model_to_string(), X)
+    rh = _raw(b.refit(X2, y2, decay_rate=0.3).model_to_string(), X)
+    np.testing.assert_allclose(rh, 0.3 * r1 + 0.7 * r0, rtol=1e-6,
+                               atol=1e-7)
+
+
+@pytest.mark.parametrize("objective,extra", [
+    ("binary", {}),
+    ("multiclass", {"num_class": 3}),
+])
+def test_refit_decay_one_is_identity(objective, extra):
+    """decay=1 keeps every leaf output, even across multiple boosting
+    rounds with gradient feedback: scores match the source model."""
+    rng = np.random.RandomState(7)
+    X = rng.rand(240, N_COLS)
+    y = (rng.randint(0, extra.get("num_class", 2), 240)
+         if objective == "multiclass"
+         else (X[:, 0] > 0.5).astype(float))
+    p = dict(PARAMS, objective=objective, **extra)
+    b = engine_train(dict(p), Dataset(X, label=y, params=dict(p)),
+                     num_boost_round=6)
+    X2 = rng.rand(240, N_COLS)
+    y2 = (rng.randint(0, extra.get("num_class", 2), 240)
+          if objective == "multiclass"
+          else (X2[:, 1] > 0.5).astype(float))
+    r1 = _raw(b.refit(X2, y2, decay_rate=1.0).model_to_string(), X)
+    np.testing.assert_allclose(r1, np.asarray(b.predict(X, raw_score=True)),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_refit_weight_equals_row_replication():
+    """An integer sample weight must act exactly like replicating the
+    row (sum_g/sum_h both scale) — the regression test for refit
+    ignoring its weights (docs/PARITY.md §Refit)."""
+    rng = np.random.RandomState(11)
+    X = rng.rand(200, N_COLS)
+    y = (X[:, 0] > 0.5).astype(float)
+    b = engine_train(dict(PARAMS),
+                     Dataset(X, label=y, params=dict(PARAMS)),
+                     num_boost_round=6)
+    X2 = rng.rand(200, N_COLS)
+    y2 = (X2[:, 1] > 0.5).astype(float)
+    w = np.where(np.arange(200) % 3 == 0, 2.0, 1.0)
+    rep = np.repeat(np.arange(200), w.astype(int))
+    weighted = _raw(b.refit(X2, y2, decay_rate=0.5,
+                            weight=w).model_to_string(), X)
+    replicated = _raw(b.refit(X2[rep], y2[rep],
+                              decay_rate=0.5).model_to_string(), X)
+    unweighted = _raw(b.refit(X2, y2, decay_rate=0.5).model_to_string(), X)
+    np.testing.assert_allclose(weighted, replicated, rtol=1e-6, atol=1e-7)
+    assert not np.allclose(weighted, unweighted)   # weights DO matter
+
+
+# ----------------------------------------------------------------------
+# config + CLI
+# ----------------------------------------------------------------------
+def test_online_config_aliases_validation_and_model_echo():
+    cfg = resolve_params({"stream_source": "/tmp/x", "online_window": 512,
+                          "online_refit_rows": 128, "continue_every": 2,
+                          "online_new_trees": 3, "publish_mode": "files",
+                          "online_ckpt_every": 2})
+    assert cfg.online_source == "/tmp/x"
+    assert cfg.online_window_rows == 512
+    assert cfg.online_refresh_rows == 128
+    assert cfg.online_continue_every == 2
+    assert cfg.online_continue_trees == 3
+    assert cfg.online_checkpoint_every == 2
+    echo = cfg.to_string()
+    for field in ("online_source", "online_window_rows",
+                  "online_refresh_rows", "online_publish_mode",
+                  "online_serve"):
+        assert field not in echo
+    for bad in ({"online_window_rows": 0},
+                {"online_refresh_rows": 600, "online_window_rows": 500},
+                {"online_publish_mode": "ftp"},
+                {"online_idle_timeout_s": 0.0},
+                {"online_checkpoint_every": 0},
+                {"task": "online", "online_publish_mode": "direct"}):
+        with pytest.raises(Exception):
+            resolve_params(bad)
+
+
+def test_cli_task_online_smoke(tmp_path):
+    """task=online end to end: offline base train, trace consumption,
+    co-located direct+files publishing, profile JSON with online_* spans
+    and HBM watermark samples, final model usable by task=predict."""
+    Xb, yb = _base_data(240)
+    data = str(tmp_path / "train.csv")
+    np.savetxt(data, np.column_stack([yb, Xb]), delimiter=",")
+    X, y = _stream_data(360)
+    trace = str(tmp_path / "s.npz")
+    save_trace(trace, X, y, batch_sizes=[120] * 3)
+    out = str(tmp_path / "model.txt")
+    prof = str(tmp_path / "profile.json")
+    smet = str(tmp_path / "serve_metrics.json")
+    rc = cli_main([
+        "task=online", f"data={data}", "header=false", "label_column=0",
+        f"online_source={trace}", f"output_model={out}",
+        "objective=binary", "num_leaves=7", "min_data_in_leaf=5",
+        "num_iterations=6", "seed=3", "deterministic=true", "verbosity=-1",
+        "online_window_rows=240", "online_refresh_rows=120",
+        "online_continue_every=2", "online_continue_trees=3",
+        "online_publish_mode=both", "online_serve=true", "serve_port=0",
+        "serve_warmup=false", "device_profile=true",
+        f"profile_output={prof}", f"serve_metrics_output={smet}",
+    ])
+    assert rc == 0
+    with open(prof) as f:
+        profile = json.load(f)
+    for span in ("online_ingest", "online_refit", "online_continue",
+                 "online_publish"):
+        assert span in profile["stages_s"], span
+    assert profile["n_iters"] == 3            # one profiler iter/refresh
+    samples = profile["hbm_watermark"]
+    assert len(samples) >= 3 and all("peak_bytes" in s for s in samples)
+    with open(smet) as f:
+        served = json.load(f)
+    assert served["serving"]["counters"]["swaps"] >= 3  # hot-swaps landed
+    # the newest snapshot doubles as the final output model
+    snap3 = str(tmp_path / "model.txt.snapshot_iter_3.txt")
+    assert _md5_file(out) == _md5_file(snap3)
+    pred_out = str(tmp_path / "pred.tsv")
+    rc = cli_main(["task=predict", f"data={data}", "header=false",
+                   "label_column=0", f"input_model={out}",
+                   f"output_result={pred_out}", "verbosity=-1"])
+    assert rc == 0 and os.path.getsize(pred_out) > 0
+
+
+def test_callable_source_and_idle_stop(tmp_path, base):
+    """A generator-backed source; the loop flushes the tail when the
+    generator ends (no idle wait on an exhausted stream)."""
+    params, base_ds, base_txt = base
+    X, y = _stream_data(150)
+
+    def gen():
+        for lo in range(0, 150, 50):
+            yield X[lo:lo + 50], y[lo:lo + 50]
+
+    op = dict(params, online_window_rows=500, online_refresh_rows=60,
+              online_continue_every=0)
+    t0 = time.monotonic()
+    s = OnlineTrainer(op, base_txt, base_ds, CallableSource(gen()),
+                      SnapshotPublisher(prefix=str(tmp_path / "m"),
+                                        mode="files")).run()
+    assert s["publishes"] == 2       # 100 rows trip the 60-row trigger,
+    assert s["consumed_rows"] == 150  # the 50-row tail flushes at EOS
+    assert time.monotonic() - t0 < op.get("online_idle_timeout_s", 10.0)

@@ -108,6 +108,64 @@ def test_p99_slo_shedding_with_sliding_window():
     assert not adm.shedding
 
 
+def test_occupancy_keyed_shedding_engage_and_hysteresis():
+    from lightgbm_tpu.serving.admission import OCCUPANCY_RECOVERY
+    t = [0.0]
+    occ = [0.2]
+    fb = _FakeBatcher(capacity=1000)             # depth never triggers
+    adm = AdmissionController(fb, occupancy_high=0.8,
+                              occupancy_observer=lambda: occ[0],
+                              clock=lambda: t[0])
+    adm.admit()                                  # 0.2 < 0.8: admitted
+    occ[0] = 0.85                                # device saturated
+    with pytest.raises(OverloadedError):
+        adm.admit()
+    assert adm.shedding
+    occ[0] = OCCUPANCY_RECOVERY * 0.8 + 0.01     # above recovery floor:
+    with pytest.raises(OverloadedError):
+        adm.admit()                              # hysteresis holds
+    occ[0] = OCCUPANCY_RECOVERY * 0.8 - 0.01     # below: disengage
+    adm.admit()
+    assert not adm.shedding
+
+
+def test_occupancy_observer_defaults_and_degrades():
+    # occupancy_high=0 disables the signal even with an observer wired
+    fb = _FakeBatcher(capacity=1000)
+    adm = AdmissionController(fb, occupancy_high=0.0,
+                              occupancy_observer=lambda: 1.0)
+    assert adm.observed_occupancy() is None
+    adm.admit()
+    # no observer and no metrics -> no signal, depth/p99 still apply
+    adm2 = AdmissionController(fb, occupancy_high=0.5)
+    assert adm2.observed_occupancy() is None
+    adm2.admit()
+    # a raising or empty observer degrades to None, never sheds
+    adm3 = AdmissionController(
+        fb, occupancy_high=0.5,
+        occupancy_observer=lambda: (_ for _ in ()).throw(RuntimeError()))
+    assert adm3.observed_occupancy() is None
+    adm3.admit()
+    adm4 = AdmissionController(fb, occupancy_high=0.5,
+                               occupancy_observer=lambda: None)
+    assert adm4.observed_occupancy() is None
+    adm4.admit()
+    # the default observer is the shared metrics' batch occupancy
+    metrics = ServingMetrics(max_batch=8)
+    adm5 = AdmissionController(fb, metrics=metrics, occupancy_high=0.5)
+    assert adm5.occupancy_observer == metrics.batch_occupancy
+    with pytest.raises(ValueError):
+        AdmissionController(fb, occupancy_high=1.5)
+    # config knob + aliases; never echoed into the model file
+    cfg = resolve_params({"admission_occupancy_high": 0.9})
+    assert cfg.serve_admission_occupancy_high == 0.9
+    cfg = resolve_params({"occupancy_high": 0.7})
+    assert cfg.serve_admission_occupancy_high == 0.7
+    assert "serve_admission_occupancy_high" not in cfg.to_string()
+    with pytest.raises(Exception):
+        resolve_params({"serve_admission_occupancy_high": 1.2})
+
+
 def test_shed_class_drop_oldest_admits_fresh():
     fb = _FakeBatcher(capacity=10)
     m = ServingMetrics()
