@@ -141,13 +141,19 @@ def main() -> None:
     # during hot-swap refreshes vs idle + refit-vs-continue cost ratio
     # (scripts/bench_online.py, docs/ONLINE.md); writes
     # BENCH_ONLINE.json
+    # BENCH_FLEET=1: multi-tenant fleet trace replay — zipfian tenant
+    # popularity, diurnal load, a flash crowd on one tenant, hot-swaps
+    # under traffic; pass/fail is per-tenant SLO isolation
+    # (scripts/bench_fleet.py, docs/SERVING.md §Multi-tenant fleet);
+    # writes BENCH_FLEET.json
     for env, script in (("BENCH_SERVING", "bench_serving.py"),
                         ("BENCH_ROWWISE", "bench_rowwise.py"),
                         ("BENCH_COMM", "bench_comm.py"),
                         ("BENCH_FUSED", "bench_fused.py"),
                         ("BENCH_RESIL", "bench_resilience.py"),
                         ("BENCH_SLO", "bench_slo.py"),
-                        ("BENCH_ONLINE", "bench_online.py")):
+                        ("BENCH_ONLINE", "bench_online.py"),
+                        ("BENCH_FLEET", "bench_fleet.py")):
         if os.environ.get(env, "") not in ("", "0"):
             import runpy
             runpy.run_path(

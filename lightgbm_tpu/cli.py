@@ -315,10 +315,227 @@ def build_http_server(cfg, registry, batcher, metrics,
         (cfg.serve_host, cfg.serve_port), Handler)
 
 
+def build_fleet_http_server(cfg, fleet):
+    """Threaded HTTP front-end for a multi-tenant ModelFleet. Routes:
+
+      POST /predict/<tenant>  — score rows against one tenant's model
+      POST /predict           — tenant from the ``X-Model`` header
+                                (default tenant key: "default")
+      GET /metrics            — fleet export: per-tenant summaries,
+                                scheduler fairness, stages_by_tenant
+      GET /health /healthz /readyz — as the single-model server, with
+                                per-tenant breaker/shedding states
+
+    Per-request deadlines and client keying are identical to
+    :func:`build_http_server`; unknown tenants map to 404."""
+    import http.server
+    import json
+    import math
+    import time as _time
+
+    from .serving import QueueFullError, RequestTimeout, ShedError
+
+    deadline_hdr = getattr(cfg, "serve_deadline_header", "") or "X-Deadline-Ms"
+    default_deadline_ms = float(getattr(cfg, "serve_deadline_ms", 0.0) or 0.0)
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *args):   # keep serving stdout quiet
+            pass
+
+        def _send(self, code: int, obj, retry_after_s: float = 0.0) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            if retry_after_s > 0.0:
+                self.send_header("Retry-After",
+                                 str(max(int(math.ceil(retry_after_s)), 1)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/metrics":
+                self._send(200, fleet.metrics_dict())
+            elif self.path == "/health":
+                self._send(200, {"status": "ok",
+                                 "tenants": fleet.tenant_names()})
+            elif self.path == "/healthz":
+                wedged = fleet.wedged()
+                ok = fleet.alive() and not wedged
+                self._send(200 if ok else 503, {
+                    "status": "ok" if ok else "unhealthy",
+                    "worker_alive": fleet.alive(),
+                    "worker_wedged": wedged,
+                })
+            elif self.path == "/readyz":
+                tenants = fleet.tenant_names()
+                ok = bool(tenants) and fleet.alive()
+                self._send(200 if ok else 503, {
+                    "status": "ready" if ok else "not_ready",
+                    "tenants": tenants,
+                    "queue_depth": fleet.depth,
+                    "states": {n: dict(fleet._tenant(n).metrics.states)
+                               for n in tenants},
+                })
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def _deadline(self):
+            ms = self.headers.get(deadline_hdr)
+            ms = float(ms) if ms is not None else default_deadline_ms
+            if ms <= 0.0:
+                return None
+            return _time.perf_counter() + ms / 1e3
+
+        def do_POST(self):
+            if self.path == "/predict":
+                tenant = self.headers.get("X-Model") or "default"
+            elif self.path.startswith("/predict/"):
+                tenant = self.path[len("/predict/"):]
+            else:
+                return self._send(404, {"error": f"no route {self.path}"})
+            if tenant not in fleet.tenant_names():
+                return self._send(404, {
+                    "error": f"no tenant {tenant!r} "
+                             f"(have {fleet.tenant_names()})"})
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+                if n > _MAX_BODY_BYTES:
+                    return self._send(413, {
+                        "error": f"request body {n} bytes exceeds the "
+                                 f"{_MAX_BODY_BYTES}-byte limit"})
+                raw = self.rfile.read(n).decode()
+                deadline = self._deadline()
+            except Exception as e:
+                return self._send(400, {"error": str(e)})
+            try:
+                rows = _parse_rows(raw)
+                if rows.size == 0 or rows.ndim != 2:
+                    raise ValueError("empty or non-rectangular row block")
+            except Exception as e:
+                return self._send(400, {"error": f"malformed body: {e}"})
+            client = self.headers.get("X-Client") or self.client_address[0]
+            try:
+                pred = fleet.predict(rows, tenant=tenant, client=client,
+                                     deadline=deadline)
+                self._send(200, {"predictions":
+                                 np.asarray(pred).tolist()})
+            except ShedError as e:
+                self._send(e.http_status, {"error": str(e)},
+                           retry_after_s=e.retry_after_s)
+            except QueueFullError as e:
+                self._send(503, {"error": str(e)}, retry_after_s=1.0)
+            except RequestTimeout as e:
+                self._send(504, {"error": str(e)})
+            except Exception as e:
+                self._send(400, {"error": str(e)})
+
+    return http.server.ThreadingHTTPServer(
+        (cfg.serve_host, cfg.serve_port), Handler)
+
+
+def run_serve_fleet(params: Dict[str, Any], cfg) -> None:
+    """task=serve with serve_models="name=path,...": multi-tenant fleet.
+    serve_port > 0 -> HTTP (POST /predict/<tenant>); data=<file> ->
+    batch-score through the FIRST tenant; else stdin lines (first
+    tenant). With serve_watch set (any non-empty value) every tenant
+    watches its own model path as a snapshot prefix."""
+    from .runtime.faults import active_plan
+    from .serving import ModelFleet
+    entries = []
+    for entry in cfg.serve_models.split(","):
+        name, path = entry.split("=", 1)
+        entries.append((name.strip(), path.strip()))
+    fault_plan = active_plan(cfg.fault_plan)
+    fleet = ModelFleet(
+        max_batch=cfg.serve_max_batch,
+        max_wait_ms=cfg.serve_batch_wait_ms,
+        queue_depth=cfg.serve_queue_depth,
+        timeout_ms=cfg.serve_request_timeout_ms,
+        raw_score=cfg.predict_raw_score, fault_plan=fault_plan,
+        session_opts=dict(
+            engine=cfg.serve_engine, min_bucket=cfg.serve_min_bucket,
+            num_shards=cfg.serve_num_shards, warmup=cfg.serve_warmup,
+            start_iteration=cfg.start_iteration_predict,
+            num_iteration=cfg.num_iteration_predict),
+        admission_opts=dict(
+            rate_qps=cfg.serve_admission_rate_qps,
+            burst=cfg.serve_admission_burst,
+            queue_high=cfg.serve_admission_queue_high,
+            queue_low=cfg.serve_admission_queue_low,
+            p99_slo_ms=cfg.serve_admission_p99_slo_ms,
+            shed_class=cfg.serve_admission_shed_class,
+            occupancy_high=cfg.serve_admission_occupancy_high),
+        breaker_opts=dict(
+            failure_threshold=cfg.serve_breaker_failures,
+            latency_slo_ms=cfg.serve_breaker_latency_slo_ms,
+            latency_trips=cfg.serve_breaker_latency_trips,
+            cooldown_s=cfg.serve_breaker_cooldown_s))
+    for name, path in entries:
+        fleet.add_model(name, path)
+        if cfg.serve_watch:
+            fleet.watch_snapshots(name, path,
+                                  poll_s=cfg.serve_watch_poll_s,
+                                  start=cfg.serve_port > 0)
+    fleet.start()
+    first = entries[0][0]
+    try:
+        if cfg.serve_port > 0:
+            server = build_fleet_http_server(cfg, fleet)
+            log_info(f"serving fleet ({len(entries)} tenants) on "
+                     f"http://{server.server_address[0]}:"
+                     f"{server.server_address[1]} (POST /predict/<tenant>, "
+                     f"GET /metrics /health /healthz /readyz)")
+            try:
+                server.serve_forever()
+            except KeyboardInterrupt:
+                pass
+            finally:
+                server.server_close()
+        elif cfg.data:
+            X, _, _, _, _ = load_text_file(
+                cfg.data, has_header=cfg.header,
+                label_column=cfg.label_column,
+                weight_column=cfg.weight_column,
+                group_column=cfg.group_column,
+                ignore_column=cfg.ignore_column)
+            results = []
+            pending = []
+            for i in range(X.shape[0]):
+                pending.append(fleet.submit(X[i], tenant=first))
+                if len(pending) >= min(cfg.serve_queue_depth, 512):
+                    results.extend(fleet.wait(r, tenant=first)
+                                   for r in pending)
+                    pending = []
+            results.extend(fleet.wait(r, tenant=first) for r in pending)
+            out = np.concatenate([np.asarray(r) for r in results], axis=0)
+            if out.ndim == 1:
+                out = out[:, None]
+            np.savetxt(cfg.output_result, out, delimiter="\t", fmt="%.18g")
+            log_info(f"Finished serving {X.shape[0]} rows through tenant "
+                     f"{first!r}; results saved to {cfg.output_result}")
+        else:
+            for line in sys.stdin:
+                if not line.strip():
+                    continue
+                pred = np.asarray(fleet.predict(_parse_rows(line),
+                                                tenant=first))
+                print("\t".join(f"{v:.18g}" for v in pred.reshape(-1)))
+    finally:
+        fleet.stop()
+        if cfg.serve_metrics_output:
+            fleet.export_json(cfg.serve_metrics_output)
+            log_info(
+                f"Serving metrics saved to {cfg.serve_metrics_output}")
+
+
 def run_serve(params: Dict[str, Any], cfg) -> None:
     """task=serve: score via the serving engine (registry + batcher).
     serve_port > 0 -> HTTP; data=<file> -> batch-score the file (output
-    bit-identical to task=predict on the host engine); else stdin lines."""
+    bit-identical to task=predict on the host engine); else stdin lines.
+    serve_models="name=path,..." switches to the multi-tenant fleet."""
+    if cfg.serve_models:
+        return run_serve_fleet(params, cfg)
     if not cfg.input_model:
         log_fatal("task=serve requires input_model")
     from .runtime.faults import active_plan
@@ -330,7 +547,7 @@ def run_serve(params: Dict[str, Any], cfg) -> None:
     # has nothing to degrade from, so it only exists when the device
     # engine is in play and at least one trip condition is enabled
     breaker = None
-    if cfg.serve_engine in ("auto", "device") and (
+    if cfg.serve_engine in ("auto", "device", "binned") and (
             cfg.serve_breaker_failures > 0
             or cfg.serve_breaker_latency_slo_ms > 0.0):
         breaker = CircuitBreaker(
@@ -478,7 +695,7 @@ def run_online(params: Dict[str, Any], cfg) -> None:
                               MicroBatcher, ModelRegistry, ServingMetrics)
         metrics = ServingMetrics(max_batch=cfg.serve_max_batch)
         breaker = None
-        if cfg.serve_engine in ("auto", "device") and (
+        if cfg.serve_engine in ("auto", "device", "binned") and (
                 cfg.serve_breaker_failures > 0
                 or cfg.serve_breaker_latency_slo_ms > 0.0):
             breaker = CircuitBreaker(

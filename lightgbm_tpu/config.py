@@ -147,6 +147,7 @@ _alias("profile_output", "profile_out", "profile_file")
 _alias("autotune", "auto_tune", "runtime_autotune")
 _alias("autotune_cache", "auto_tune_cache", "autotune_cache_filename")
 _alias("serve_engine", "serving_engine")
+_alias("serve_models", "serving_models", "serve_model_list")
 _alias("serve_max_batch", "serving_max_batch")
 _alias("serve_batch_wait_ms", "serve_max_wait_ms", "batch_wait_ms")
 _alias("serve_request_timeout_ms", "serve_timeout_ms")
@@ -329,7 +330,11 @@ class Config:
     convert_model: str = "gbdt_prediction.cpp"
 
     # -- serving (task=serve; lightgbm_tpu/serving/, docs/SERVING.md)
-    serve_engine: str = "auto"         # auto | host | device
+    serve_engine: str = "auto"         # auto | host | device | binned
+    # multi-tenant fleet: "name=model_path,name=model_path" deploys each
+    # model under its tenant key behind one shared scoring worker
+    # (serving/fleet.py); empty = single-model serving
+    serve_models: str = ""
     serve_max_batch: int = 256         # rounded up to a power of two
     serve_min_bucket: int = 8          # smallest padded batch bucket
     serve_batch_wait_ms: float = 2.0   # micro-batch coalescing window
@@ -623,6 +628,14 @@ class Config:
         if not (0.0 <= self.serve_admission_occupancy_high <= 1.0):
             log_fatal("serve_admission_occupancy_high should be in "
                       "[0.0, 1.0] (0 disables occupancy shedding)")
+        if self.serve_models:
+            for entry in self.serve_models.split(","):
+                if "=" not in entry or not entry.split("=", 1)[0].strip() \
+                        or not entry.split("=", 1)[1].strip():
+                    log_fatal(
+                        f"serve_models entry '{entry.strip()}' is not "
+                        "'name=model_path' (expected e.g. "
+                        "'alpha=a.txt,beta=b.txt'; docs/SERVING.md)")
         # online-loop knobs fail fast so a bad flag can't surface
         # mid-stream (docs/ONLINE.md)
         if self.online_window_rows < 1:
@@ -682,7 +695,7 @@ class Config:
         "serve_deadline_ms", "serve_deadline_header",
         "serve_breaker_failures", "serve_breaker_latency_slo_ms",
         "serve_breaker_latency_trips", "serve_breaker_cooldown_s",
-        "serve_admission_occupancy_high",
+        "serve_admission_occupancy_high", "serve_models",
         # online-loop knobs describe the refresh ORCHESTRATION, not the
         # model: every published snapshot must stay byte-identical to
         # the offline one-shot refit/continue on the same data

@@ -163,10 +163,21 @@ def _decide_children(tree, x: np.ndarray, node: int):
 def predict_contrib(gbdt, X: np.ndarray, start_iteration: int = 0,
                     num_iteration: int = -1) -> np.ndarray:
     """[N, (F+1) * K] SHAP values (+ expected value column per class)."""
-    if any(getattr(t, "is_linear", False) for t in gbdt.models):
-        from ..utils.log import log_fatal
-        log_fatal("pred_contrib (TreeSHAP) is not supported for "
-                  "linear trees")
+    # fail loudly, not silently: a linear tree's leaf value is a fitted
+    # linear function of the features, so path-attribution TreeSHAP over
+    # constant leaves would produce numbers that LOOK like SHAP values
+    # but attribute none of the within-leaf linear term (the documented
+    # known gap, README.md "Known gaps": linear_tree pred_contrib)
+    linear = [i for i, t in enumerate(gbdt.models)
+              if getattr(t, "is_linear", False)]
+    if linear:
+        raise ValueError(
+            "pred_contrib (TreeSHAP) is not supported for linear trees: "
+            f"tree(s) {linear[:8]}{'...' if len(linear) > 8 else ''} carry "
+            "fitted leaf coefficients whose within-leaf contribution "
+            "path-attribution cannot decompose; use predict() for values "
+            "or retrain with linear_tree=false for attributions "
+            "(README.md known gap)")
     X = np.asarray(X, dtype=np.float64)
     N = X.shape[0]
     F = gbdt.max_feature_idx_ + 1

@@ -18,14 +18,15 @@ Wired into the CLI as ``task=online`` (cli.py run_online).
 """
 
 from .publisher import PUBLISH_MODES, SnapshotPublisher
-from .source import (BatchSource, CallableSource, DirectorySource,
-                     MicroBatch, SchemaDriftError, TraceSource,
-                     check_batch_schema, open_source, save_trace)
+from .source import (ArrowSource, BatchSource, CallableSource,
+                     DirectorySource, MicroBatch, SchemaDriftError,
+                     SequenceSource, TraceSource, check_batch_schema,
+                     open_source, save_trace)
 from .trainer import ONLINE_STATE_KIND, OnlineTrainer
 
 __all__ = [
-    "BatchSource", "CallableSource", "DirectorySource", "MicroBatch",
-    "SchemaDriftError", "TraceSource", "check_batch_schema",
-    "open_source", "save_trace", "PUBLISH_MODES", "SnapshotPublisher",
-    "ONLINE_STATE_KIND", "OnlineTrainer",
+    "ArrowSource", "BatchSource", "CallableSource", "DirectorySource",
+    "MicroBatch", "SchemaDriftError", "SequenceSource", "TraceSource",
+    "check_batch_schema", "open_source", "save_trace", "PUBLISH_MODES",
+    "SnapshotPublisher", "ONLINE_STATE_KIND", "OnlineTrainer",
 ]

@@ -17,6 +17,13 @@ stories:
    batch, SEEKABLE to any batch index — the deterministic-resume and
    bench workhorse: a killed loop seeks to its checkpointed position and
    re-consumes the identical remaining batches.
+ * :class:`ArrowSource` — pyarrow Tables / RecordBatches (a Table is
+   sliced into batch-sized chunks and is seekable; a RecordBatch
+   iterator streams live), label split out by column, reusing the same
+   Arrow→numpy conversion as ``Dataset(data=<pyarrow>)``.
+ * :class:`SequenceSource` — a :class:`lightgbm_tpu.Sequence` (the
+   out-of-core ``__len__``/``__getitem__`` ingestion interface) replayed
+   in ``batch_size`` slices; seekable via random access.
 
 Binning happens in the TRAINER against the frozen base-model mappers
 (Dataset.init_streaming/push_rows) — sources hand over raw floats and
@@ -311,6 +318,112 @@ class TraceSource(BatchSource):
             self.exhausted = True
 
 
+def _split_label(mat: np.ndarray, label_column: int,
+                 weight_column: Optional[int]):
+    """Matrix -> (X, y[, weight]) by column index (the CSV ``label in
+    column 0`` convention generalized)."""
+    cols = [c for c in range(mat.shape[1])
+            if c != label_column and c != weight_column]
+    w = None if weight_column is None else mat[:, weight_column]
+    return mat[:, cols], mat[:, label_column], w
+
+
+class ArrowSource(BatchSource):
+    """Micro-batches from pyarrow data. Accepts a ``pa.Table`` (sliced
+    into ``batch_rows`` chunks, SEEKABLE) or any iterator/reader of
+    ``pa.RecordBatch``/``pa.Table`` items (streamed, not seekable —
+    e.g. ``RecordBatchFileReader``/flight streams the caller owns).
+    The label (and optional weight) ride along as columns, split out by
+    index after the same Arrow→numpy conversion ``Dataset`` uses
+    (basic.py ``_arrow_to_numpy``) — so a batch that would not bin for
+    Dataset construction fails the same way here."""
+
+    def __init__(self, data, fault_plan=None, batch_rows: int = 256,
+                 label_column: int = 0,
+                 weight_column: Optional[int] = None) -> None:
+        super().__init__(fault_plan)
+        from ..basic import _is_arrow
+        self.label_column = int(label_column)
+        self.weight_column = weight_column if weight_column is None \
+            else int(weight_column)
+        self.batch_rows = max(int(batch_rows), 1)
+        if _is_arrow(data) and hasattr(data, "slice"):   # Table
+            self._table = data
+            self._it = None
+        else:
+            self._table = None
+            self._it = iter(data)
+
+    def _convert(self, chunk) -> Any:
+        from ..basic import _arrow_to_numpy
+        mat = _arrow_to_numpy(chunk)
+        return _split_label(mat, self.label_column, self.weight_column)
+
+    def _pull(self, timeout_s: float) -> Optional[Any]:
+        if self._table is not None:
+            lo = self.seq * self.batch_rows
+            if lo >= self._table.num_rows:
+                self.exhausted = True
+                return None
+            return self._convert(self._table.slice(lo, self.batch_rows))
+        try:
+            return self._convert(next(self._it))
+        except StopIteration:
+            self.exhausted = True
+            return None
+
+    def seek(self, n_batches: int) -> None:
+        if self._table is None:
+            raise NotImplementedError(
+                "ArrowSource over a record-batch stream is not seekable; "
+                "resume replays from the live position")
+        self.seq = int(n_batches)
+        if self.seq * self.batch_rows >= self._table.num_rows:
+            self.exhausted = True
+
+
+class SequenceSource(BatchSource):
+    """Replay a :class:`lightgbm_tpu.Sequence` (out-of-core row access,
+    basic.py) as micro-batches of ``seq.batch_size`` rows (override with
+    ``batch_rows``), label split out by column like :class:`ArrowSource`.
+    Random access makes it SEEKABLE — the same kill/resume contract as
+    :class:`TraceSource`, without materializing the data."""
+
+    def __init__(self, sequence, fault_plan=None, batch_rows: int = 0,
+                 label_column: int = 0,
+                 weight_column: Optional[int] = None) -> None:
+        super().__init__(fault_plan)
+        if not (hasattr(sequence, "__len__")
+                and hasattr(sequence, "__getitem__")):
+            raise TypeError(
+                f"SequenceSource needs __len__/__getitem__ (the "
+                f"lightgbm_tpu.Sequence interface), got "
+                f"{type(sequence).__name__}")
+        self.sequence = sequence
+        self.batch_rows = int(batch_rows) if batch_rows > 0 else \
+            int(getattr(sequence, "batch_size", 65536))
+        self.label_column = int(label_column)
+        self.weight_column = weight_column if weight_column is None \
+            else int(weight_column)
+
+    def _pull(self, timeout_s: float) -> Optional[Any]:
+        lo = self.seq * self.batch_rows
+        n = len(self.sequence)
+        if lo >= n:
+            self.exhausted = True
+            return None
+        mat = np.asarray(
+            self.sequence[lo:min(lo + self.batch_rows, n)], np.float64)
+        if mat.ndim == 1:
+            mat = mat.reshape(1, -1)
+        return _split_label(mat, self.label_column, self.weight_column)
+
+    def seek(self, n_batches: int) -> None:
+        self.seq = int(n_batches)
+        if self.seq * self.batch_rows >= len(self.sequence):
+            self.exhausted = True
+
+
 def save_trace(path: str, X, y, weight=None, batch_sizes=None) -> None:
     """Write a TraceSource-compatible ``.npz`` (bench + test helper)."""
     arrays = {"X": np.asarray(X, np.float64),
@@ -322,10 +435,26 @@ def save_trace(path: str, X, y, weight=None, batch_sizes=None) -> None:
     np.savez(path, **arrays)
 
 
-def open_source(spec: str, fault_plan=None,
+def open_source(spec, fault_plan=None,
                 batch_rows: int = 256) -> BatchSource:
-    """CLI entry (``online_source=...``): a directory tails, an ``.npz``
-    file replays as a trace."""
+    """CLI/API entry (``online_source=...``): a directory tails, an
+    ``.npz`` file replays as a trace; programmatic callers may also pass
+    a ready :class:`BatchSource`, a pyarrow Table/RecordBatch stream, or
+    a :class:`lightgbm_tpu.Sequence` directly."""
+    if isinstance(spec, BatchSource):
+        return spec
+    if not isinstance(spec, (str, os.PathLike)):
+        from ..basic import Sequence, _is_arrow
+        if _is_arrow(spec):   # Table, RecordBatch, or a pyarrow reader
+            return ArrowSource(spec, fault_plan=fault_plan,
+                               batch_rows=batch_rows)
+        if isinstance(spec, Sequence) or (
+                hasattr(spec, "__len__") and hasattr(spec, "__getitem__")):
+            return SequenceSource(spec, fault_plan=fault_plan)
+        raise TypeError(
+            f"online_source of type {type(spec).__name__} is not a path, "
+            "BatchSource, pyarrow data, or Sequence (docs/ONLINE.md)")
+    spec = str(spec)
     if os.path.isdir(spec):
         return DirectorySource(spec, fault_plan=fault_plan)
     if os.path.isfile(spec) and spec.endswith(".npz"):

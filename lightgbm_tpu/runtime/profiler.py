@@ -173,14 +173,21 @@ class StageProfiler:
         # by the multi-host training loop (or synthetically by tests)
         self.rank_spans: Dict[str, List[List[float]]] = {}
         self.straggler_threshold = 1.5
+        # multi-tenant serving (serving/fleet.py): spans tagged with a
+        # tenant ALSO accumulate into a per-tenant table, exported as
+        # "stages_by_tenant" — per-model device time never aggregates
+        # across a shared pool
+        self.tenant_totals: Dict[str, Dict[str, float]] = {}
 
     # -- span recording ---------------------------------------------------
 
     @contextlib.contextmanager
-    def span(self, name: str):
+    def span(self, name: str, tenant: Optional[str] = None):
         """Fence the device, time the block, fence again. Inside an
         iteration the span lands in that iteration's record; outside it
-        accumulates into totals only (init-scope work such as "bin")."""
+        accumulates into totals only (init-scope work such as "bin").
+        With ``tenant`` set, the span also lands in that tenant's row of
+        the per-tenant table (fleet serving)."""
         self._barrier()
         t0 = self._clock()
         try:
@@ -192,6 +199,9 @@ class StageProfiler:
             self.counts[name] = self.counts.get(name, 0) + 1
             if self._iter_spans is not None:
                 self._iter_spans[name] = self._iter_spans.get(name, 0.0) + dt
+            if tenant is not None:
+                row = self.tenant_totals.setdefault(str(tenant), {})
+                row[name] = row.get(name, 0.0) + dt
 
     def iter_start(self) -> None:
         self._barrier()
@@ -319,6 +329,11 @@ class StageProfiler:
             out["hbm_peak_bytes"] = self.hbm_peak_bytes
         if self.rank_spans:
             out["stragglers"] = self.straggler_report()
+        if self.tenant_totals:
+            out["stages_by_tenant"] = {
+                t: {n: round(v, 6) for n, v in
+                    sorted(row.items(), key=lambda kv: -kv[1])}
+                for t, row in sorted(self.tenant_totals.items())}
         if self.extras:
             out.update(self.extras)
         return out

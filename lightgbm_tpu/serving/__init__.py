@@ -11,12 +11,16 @@
  * registry.py  — ModelRegistry: atomic hot-swap, snapshot watching
  * metrics.py   — ServingMetrics: QPS / p50 / p99 / occupancy / hit rate,
                   exported through runtime/profiler JSON
+ * fleet.py     — ModelFleet: multi-tenant serving over one device pool
+                  (per-tenant registry/breaker/admission, EDF continuous
+                  batching across tenants)
 """
 
 from .admission import (AdmissionController, OverloadedError,
                         RateLimitedError, ShedError)
 from .batcher import MicroBatcher, QueueFullError, RequestTimeout
 from .breaker import CircuitBreaker
+from .fleet import ModelFleet
 from .metrics import ServingMetrics
 from .registry import ModelRegistry
 from .session import CompiledPredictorCache, ServingSession, bucket_for
@@ -25,6 +29,6 @@ __all__ = [
     "ServingSession", "CompiledPredictorCache", "bucket_for",
     "MicroBatcher", "QueueFullError", "RequestTimeout",
     "AdmissionController", "ShedError", "RateLimitedError",
-    "OverloadedError", "CircuitBreaker",
+    "OverloadedError", "CircuitBreaker", "ModelFleet",
     "ModelRegistry", "ServingMetrics",
 ]

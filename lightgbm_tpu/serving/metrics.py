@@ -26,9 +26,12 @@ class ServingMetrics:
     cache hit rate measures exactly that amortization)."""
 
     def __init__(self, max_batch: int = 0,
-                 clock=time.perf_counter) -> None:
+                 clock=time.perf_counter, tenant: str = "") -> None:
         self._lock = threading.Lock()
         self._clock = clock
+        # fleet serving (serving/fleet.py): one ServingMetrics per
+        # tenant, so QPS/p50/p99/occupancy never aggregate across models
+        self.tenant = tenant
         self.start_t = clock()
         # profiler WITHOUT device fencing: serving spans time enqueued
         # host work per batch; a live-traffic barrier per batch would
@@ -121,7 +124,9 @@ class ServingMetrics:
         dt = self._clock() - self.start_t
         return self.counters["requests"] / dt if dt > 0 else 0.0
 
-    def to_dict(self) -> Dict[str, Any]:
+    def summary(self) -> Dict[str, Any]:
+        """The serving summary dict alone (no profiler wrap) — what the
+        fleet exports per tenant (serving/fleet.py)."""
         with self._lock:
             serving: Dict[str, Any] = {
                 "uptime_s": round(self._clock() - self.start_t, 3),
@@ -130,6 +135,8 @@ class ServingMetrics:
                 "request_latency": self.request_latency.to_dict(),
                 "batch_latency": self.batch_latency.to_dict(),
             }
+            if self.tenant:
+                serving["tenant"] = self.tenant
             hr = self.cache_hit_rate()
             if hr is not None:
                 serving["cache_hit_rate"] = round(hr, 4)
@@ -141,8 +148,11 @@ class ServingMetrics:
                     self.counters["rows"] / self.counters["batches"], 2)
             if self.states:
                 serving["states"] = dict(self.states)
-            self.profiler.extras["serving"] = serving
-            return self.profiler.to_dict()
+            return serving
+
+    def to_dict(self) -> Dict[str, Any]:
+        self.profiler.extras["serving"] = self.summary()
+        return self.profiler.to_dict()
 
     def export_json(self, path: str = "") -> str:
         self.to_dict()     # refresh extras["serving"] before export

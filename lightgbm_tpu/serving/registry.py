@@ -204,7 +204,11 @@ class ModelRegistry:
         # shared across versions so an OPEN device path stays degraded
         # through a hot-swap instead of resetting to closed on every
         # promote, and HBM sampling survives swaps
-        for k in ("breaker", "fault_plan", "profiler"):
+        # bin_mappers too: a snapshot reloaded from text carries no
+        # frozen mappers, so the binned engine would silently fall back
+        # to host on every promote without the carry (the new session
+        # still prefers the new model's own mappers when present)
+        for k in ("breaker", "fault_plan", "profiler", "bin_mappers"):
             if getattr(old, k, None) is not None:
                 opts.setdefault(k, getattr(old, k))
         sess = self._build(model, old.version + 1, opts)

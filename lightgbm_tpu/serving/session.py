@@ -26,6 +26,14 @@ Engines:
    predict_margin_packed) with f32-floored thresholds: rows route through
    the trees exactly like the host walk, but leaf-value accumulation is
    f32, so outputs agree to ~1e-6 relative, not bitwise (docs/SERVING.md).
+ * ``binned`` — the bin-domain walk (ops/predict_binned.py): rows are
+   binned ONCE through the model's frozen BinMappers, then scored with
+   uint8 bin-index compares against bin-mapped thresholds — routing is
+   exact by construction (split thresholds ARE bin upper bounds), so
+   outputs are bit-identical to the f32 device walk, and the feature
+   transfer shrinks 8x. Requires frozen mappers (in-process-trained
+   models have them; pass ``bin_mappers=`` for loaded ones) — otherwise
+   falls back to host loudly.
  * ``auto``  — device on TPU backends, host elsewhere.
 """
 
@@ -90,7 +98,7 @@ class ServingSession:
                  num_iteration: int = -1, warmup: bool = False,
                  metrics: Optional[ServingMetrics] = None,
                  version: int = 0, breaker=None, fault_plan=None,
-                 profiler=None) -> None:
+                 profiler=None, bin_mappers=None) -> None:
         self.gbdt = gbdt
         # graceful-degradation circuit breaker (serving/breaker.py):
         # guards the device scoring path; shared across hot-swapped
@@ -119,6 +127,13 @@ class ServingSession:
                          if gbdt.average_output else 0)
         self._has_linear = any(getattr(t, "is_linear", False)
                                for t in gbdt.models)
+        # frozen per-feature BinMappers for the binned engine: a freshly
+        # trained gbdt carries its own (definitive); otherwise the
+        # caller-provided set (carried across hot-swaps, registry.py)
+        from ..ops.predict_binned import mappers_for
+        derived = mappers_for(gbdt)
+        self.bin_mappers = derived if derived is not None else bin_mappers
+        self._bm = None
 
         self.max_batch = 1 << max(int(max_batch) - 1, 0).bit_length()
         self.requested_engine = engine
@@ -143,20 +158,32 @@ class ServingSession:
                 self._mesh = make_data_mesh(shards)
                 self.num_shards = shards
         elif num_shards > 1:
-            log_warning("serving num_shards ignored on the host engine")
+            log_warning(f"serving num_shards ignored on engine "
+                        f"{self.engine!r}")
         self.min_bucket = bucket_for(
             max(int(min_bucket), self.num_shards or 1), 1, self.max_batch)
         self._lock = threading.Lock()
         self._device_jit = None
+        self._binned_jit = None
         if warmup:
             self.warmup()
 
     # ------------------------------------------------------------------
     def _resolve_engine(self, engine: str) -> str:
-        if engine not in ("auto", "host", "device"):
+        if engine not in ("auto", "host", "device", "binned"):
             raise ValueError(f"unknown serving engine {engine!r}")
         if engine == "host":
             return "host"
+        if engine == "binned":
+            from ..ops.predict_binned import (BinnedUnavailable,
+                                              build_binned_model)
+            try:
+                self._bm = build_binned_model(self._pm, self.bin_mappers)
+                return "binned"
+            except BinnedUnavailable as e:
+                log_warning(f"serving: binned engine unavailable ({e}); "
+                            f"falling back to host")
+                return "host"
         if self._has_linear:
             # graceful fallback: linear leaves only exist on the host
             # paths (tree.cpp AddPredictionToScore linear path)
@@ -216,9 +243,27 @@ class ServingSession:
                 self._device_jit = jax.jit(score)
         return self._device_jit
 
+    def _binned_scorer(self, bucket: int) -> Callable:
+        """Jitted bin-domain scorer: uint8 [b, F] bins -> [K, b] f32
+        margins, bit-identical to the device f32 raw walk by
+        construction (ops/predict_binned.py)."""
+        if self._binned_jit is None:
+            import jax
+            from ..ops.predict_binned import predict_margin_binned
+            pa = self._bm.device_arrays()
+            K = self.K
+
+            def score(Xp):                       # [b, F] u8 -> [K, b]
+                return predict_margin_binned(pa, Xp, K)
+
+            self._binned_jit = jax.jit(score)
+        return self._binned_jit
+
     def _build_scorer(self, bucket: int) -> Callable:
         if self.engine == "device":
             return self._device_scorer(bucket)
+        if self.engine == "binned":
+            return self._binned_scorer(bucket)
         # host entries are trivially warm closures over the packed model;
         # they ride the same cache so hit-rate accounting is uniform
         return self._pm.predict_margin
@@ -239,6 +284,10 @@ class ServingSession:
             if self.engine == "device":
                 import jax
                 out = fn(np.zeros((b, F), np.float32))
+                jax.block_until_ready(out)
+            elif self.engine == "binned":
+                import jax
+                out = fn(np.zeros((b, self._bm.num_features), np.uint8))
                 jax.block_until_ready(out)
         log_info(f"serving warmup: engine={self.engine} "
                  f"buckets={ladder} shards={self.num_shards or 1}")
@@ -261,12 +310,26 @@ class ServingSession:
         Xp[:m] = X[c0:c1]
         return np.asarray(jax.device_get(fn(Xp)))[:, :m].astype(np.float64)
 
+    def _score_binned(self, X: np.ndarray, c0: int, c1: int,
+                      b: int) -> np.ndarray:
+        """Bin the chunk once through the frozen mappers (host-side
+        searchsorted), then score uint8 bins on device — an 8x smaller
+        transfer than the f32 path, bit-identical output."""
+        import jax
+        fn = self._cache.get((self.version, "binned", b),
+                             lambda b=b: self._build_scorer(b))
+        m = c1 - c0
+        Xp = np.zeros((b, self._bm.num_features), np.uint8)
+        Xp[:m] = self._bm.bin_rows(X[c0:c1])
+        return np.asarray(jax.device_get(fn(Xp)))[:, :m].astype(np.float64)
+
     def score_margin(self, X: np.ndarray) -> np.ndarray:
         """[K, n] f64 raw margins for X [n, F] (f64 in, any request
         size: chunks of up to max_batch, each padded to its bucket).
 
         Engine degradation (docs/SERVING.md §Overload & SLOs): when a
-        circuit breaker is attached and the engine is ``device``, each
+        circuit breaker is attached and the engine is ``device`` (or
+        ``binned``), each
         chunk first asks ``breaker.allow()`` — an OPEN breaker routes
         the chunk through the host walk (bit-identical to
         ``Booster.predict``, counted as ``host_fallbacks``) until a
@@ -281,21 +344,25 @@ class ServingSession:
             m = c1 - c0
             b = bucket_for(m, self.min_bucket, self.max_batch)
             seq, self._n_scored = self._n_scored, self._n_scored + 1
-            use_device = self.engine == "device"
-            if use_device and self.breaker is not None \
+            # "device" and "binned" are both accelerator paths: breaker-
+            # guarded, host re-score on failure
+            use_accel = self.engine in ("device", "binned")
+            if use_accel and self.breaker is not None \
                     and not self.breaker.allow():
-                use_device = False
+                use_accel = False
                 self.metrics.inc("host_fallbacks")
             t0 = time.perf_counter()
             if self.fault_plan is not None:
                 # inside the timed region: the injected delay must show
                 # up in batch latency (latency-SLO shed / breaker trip)
                 self.fault_plan.slow_score(seq)
-            if use_device:
+            if use_accel:
                 try:
                     if self.fault_plan is not None:
                         self.fault_plan.fail_score(seq)
-                    r = self._score_device(X, c0, c1, b)
+                    r = (self._score_binned(X, c0, c1, b)
+                         if self.engine == "binned"
+                         else self._score_device(X, c0, c1, b))
                     if self.breaker is not None:
                         self.breaker.record_success(
                             time.perf_counter() - t0)
@@ -303,7 +370,7 @@ class ServingSession:
                     if self.breaker is not None:
                         self.breaker.record_failure(e)
                     self.metrics.inc("host_fallbacks")
-                    log_warning(f"serving: device scoring failed "
+                    log_warning(f"serving: {self.engine} scoring failed "
                                 f"({e!r}); chunk re-scored on host")
                     r = self._host_fn(b)(X[c0:c1])
             else:

@@ -1,0 +1,288 @@
+"""Multi-tenant fleet trace replay: per-tenant SLO isolation under a
+flash crowd (docs/SERVING.md §Multi-tenant fleet).
+
+One :class:`ModelFleet` serves N>=8 tenant models off one device pool.
+A synthetic trace over a million-user id space replays against it:
+
+ * **zipfian tenant popularity** — tenant i's share of the background
+   load is ``1/(i+1)**s`` normalized (the head tenant gets ~10x the
+   tail tenant's traffic);
+ * **diurnal load curve** — every tenant's offered rate follows a
+   compressed day: ``1 + 0.25*sin(...)``, trough at the start, peak
+   mid-run;
+ * **flash crowd** — mid-run, a handful of viral client ids hammer ONE
+   mid-popularity tenant at ~10x its organic rate. That tenant's own
+   admission token bucket sheds the hot clients in O(1) at submit
+   (429-style); its queue watermarks are the backstop;
+ * **hot-swaps under traffic** — >=3 promotes on other tenants while
+   the crowd is in progress.
+
+Pass/fail is per-tenant SLO isolation, measured from the replay itself:
+the crowd tenant sheds, while EVERY other tenant's accepted p99 during
+the crowd stays within ``FLEET_ISOLATION_FACTOR`` (default 1.2) of its
+own idle-phase p99 — and zero request errors fleet-wide, including
+across the hot-swaps.
+
+Writes ``BENCH_FLEET.json`` at the repo root (consumed by
+scripts/check_stale_claims.py) and prints it; also runnable via
+``BENCH_FLEET=1 python bench.py``. Env knobs: FLEET_TENANTS,
+FLEET_QPS (background aggregate), FLEET_CROWD_QPS, FLEET_SERVICE_MS
+(injected per-batch service time), FLEET_PHASE_S (idle/crowd window
+length), FLEET_ENGINE, FLEET_ISOLATION_FACTOR.
+"""
+
+import json
+import math
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+USERS = 1_000_000
+
+
+def _pct(vals, q):
+    if not vals:
+        return None
+    s = sorted(vals)
+    return round(s[min(len(s) - 1, int(round(q * (len(s) - 1))))] * 1e3, 2)
+
+
+def main() -> None:
+    n_tenants = max(int(os.environ.get("FLEET_TENANTS", "8")), 2)
+    total_qps = float(os.environ.get("FLEET_QPS", "900"))
+    crowd_qps = float(os.environ.get("FLEET_CROWD_QPS", "1200"))
+    service_ms = float(os.environ.get("FLEET_SERVICE_MS", "2"))
+    phase_s = float(os.environ.get("FLEET_PHASE_S", "4.0"))
+    # host engine by default: the bench measures the SCHEDULER (per-
+    # tenant isolation), and the host walk has no jit warmup to pollute
+    # the replay window on CPU. FLEET_ENGINE=binned runs the same replay
+    # on the binned device engine (bit-parity is gated by tier-1 tests).
+    engine = os.environ.get("FLEET_ENGINE", "host")
+    factor = float(os.environ.get("FLEET_ISOLATION_FACTOR", "1.2"))
+    zipf_s = 0.9
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.runtime.faults import FaultPlan
+    from lightgbm_tpu.serving import ModelFleet, ShedError
+
+    cols = 8
+    rng = np.random.RandomState(11)
+
+    def train(seed_col):
+        X = rng.normal(size=(500, cols))
+        y = X[:, seed_col % cols] * 2 + 0.1 * rng.normal(size=500)
+        return lgb.train(dict(objective="regression", num_leaves=15,
+                              verbose=-1, min_data_in_leaf=5),
+                         lgb.Dataset(X, label=y), num_boost_round=8)
+
+    print(f"# training {n_tenants} tenant models + 2 swap candidates",
+          flush=True)
+    models = [train(i) for i in range(n_tenants)]
+    swap_pool = [train(100), train(101)]
+
+    # zipfian tenant popularity over the background load, with a
+    # uniform floor so tail tenants still collect enough accepted
+    # requests for a stable per-tenant p99
+    w = np.array([1.0 / (i + 1) ** zipf_s for i in range(n_tenants)])
+    w = 0.7 * w / w.sum() + 0.3 / n_tenants
+    names = [f"m{i}" for i in range(n_tenants)]
+    crowd_tenant = names[1]          # a mid-popularity tenant goes viral
+    swap_tenant = names[min(3, n_tenants - 1)]
+
+    # the injected service time pins per-batch cost, so the bench
+    # measures the SCHEDULER (fairness, shedding), not CPU jit noise
+    plan = FaultPlan.parse(
+        f"slow_score@batch=0:ms={service_ms}:times={10**9}")
+    fleet = ModelFleet(
+        max_batch=64, max_wait_ms=1.0, queue_depth=256, timeout_ms=2000.0,
+        fault_plan=plan, session_opts={"engine": engine})
+    for name, model in zip(names, models):
+        opts = {}
+        if name == crowd_tenant:
+            # per-client token bucket + queue watermarks: the viral
+            # clients shed at THIS tenant, in O(1), on the submit path
+            opts = {"rate_qps": 40.0, "burst": 20.0,
+                    "queue_high": 0.5, "queue_low": 0.25}
+        fleet.add_model(name, model, admission_opts=opts)
+    fleet.start()
+
+    row = np.zeros((1, cols))
+    # pay any per-tenant first-batch costs (engine warmup, cache fills)
+    # before the measured replay opens
+    for name in names:
+        for k in (1, 8):     # <= the crowd tenant's burst (1 row = 1 token)
+            fleet.predict(np.zeros((k, cols)), tenant=name,
+                          client=f"warm{k}")
+    t_start = time.perf_counter()
+    t1, t2, t3 = phase_s, 2 * phase_s, 2 * phase_s + 0.4
+
+    def phase_of(t_rel):
+        return "idle" if t_rel < t1 else ("crowd" if t_rel < t2 else "post")
+
+    lat = {n: {"idle": [], "crowd": [], "post": []} for n in names}
+    shed = {n: 0 for n in names}
+    errors = []
+    lock = threading.Lock()
+    inflight: "queue.Queue" = queue.Queue()
+    gen_done = threading.Event()
+
+    def submit_one(tenant, client, t_rel):
+        t0 = time.perf_counter()
+        try:
+            req = fleet.submit(row, tenant=tenant, client=client)
+            inflight.put((req, tenant, phase_of(t_rel), t0))
+        except ShedError:
+            with lock:
+                shed[tenant] += 1
+        except Exception as e:          # a real failure: the bench fails
+            with lock:
+                errors.append((tenant, repr(e)))
+
+    def background(tenant, base_qps, seed):
+        trng = np.random.RandomState(seed)
+        t_rel = 0.05
+        while t_rel < t3:
+            # compressed diurnal curve: trough at start, peak mid-run
+            rate = base_qps * (1.0 + 0.25 * math.sin(
+                2 * math.pi * t_rel / t3 - math.pi / 2))
+            t_rel += 1.0 / max(rate, 1.0)
+            wait = t_start + t_rel - time.perf_counter()
+            if wait > 0:
+                time.sleep(wait)
+            submit_one(tenant, f"u{trng.randint(USERS)}", t_rel)
+
+    def crowd(worker_idx, n_workers):
+        """The flash crowd: a handful of viral client ids, 10x load."""
+        per = crowd_qps / n_workers
+        t_rel = t1
+        while t_rel < t2:
+            t_rel += 1.0 / per
+            wait = t_start + t_rel - time.perf_counter()
+            if wait > 0:
+                time.sleep(wait)
+            submit_one(crowd_tenant,
+                       f"viral{(worker_idx + int(t_rel * per)) % 6}", t_rel)
+
+    def swapper():
+        """>=3 hot-swaps on a quiet tenant while the crowd rages."""
+        pool = [swap_pool[0], swap_pool[1], models[0]]
+        for i, model in enumerate(pool):
+            wait = t_start + t1 + (i + 1) * (t2 - t1) / 4 - \
+                time.perf_counter()
+            if wait > 0:
+                time.sleep(wait)
+            try:
+                fleet.promote(swap_tenant, model)
+            except Exception as e:
+                with lock:
+                    errors.append((swap_tenant, f"promote: {e!r}"))
+
+    def waiter():
+        while True:
+            try:
+                req, tenant, phase, t0 = inflight.get(timeout=0.2)
+            except queue.Empty:
+                if gen_done.is_set():
+                    return
+                continue
+            try:
+                fleet.wait(req, tenant=tenant, timeout=4.0)
+                with lock:
+                    lat[tenant][phase].append(time.perf_counter() - t0)
+            except Exception as e:
+                with lock:
+                    errors.append((tenant, repr(e)))
+
+    gens = [threading.Thread(target=background,
+                             args=(n, total_qps * w[i], 1000 + i))
+            for i, n in enumerate(names)]
+    gens += [threading.Thread(target=crowd, args=(k, 2)) for k in range(2)]
+    gens.append(threading.Thread(target=swapper))
+    # enough waiters to cover the in-flight population (~offered_qps x
+    # typical latency): a short pool serializes completions and the
+    # handoff lag would pollute the measured tails
+    waits = [threading.Thread(target=waiter) for _ in range(24)]
+    for t in gens + waits:
+        t.start()
+    for t in gens:
+        t.join()
+    gen_done.set()
+    for t in waits:
+        t.join()
+
+    d = fleet.metrics_dict()
+    fleet.stop()
+
+    per_tenant = {}
+    isolation_ok = True
+    for n in names:
+        counters = d["fleet"]["tenants"][n]["counters"]
+        idle_p99 = _pct(lat[n]["idle"], 0.99)
+        crowd_p99 = _pct(lat[n]["crowd"], 0.99)
+        ratio = (round(crowd_p99 / idle_p99, 3)
+                 if idle_p99 and crowd_p99 else None)
+        isolated = (n == crowd_tenant) or ratio is None or ratio <= factor
+        isolation_ok &= isolated
+        per_tenant[n] = {
+            "idle": {"accepted": len(lat[n]["idle"]),
+                     "p50_ms": _pct(lat[n]["idle"], 0.50),
+                     "p99_ms": idle_p99},
+            "crowd": {"accepted": len(lat[n]["crowd"]),
+                      "p50_ms": _pct(lat[n]["crowd"], 0.50),
+                      "p99_ms": crowd_p99},
+            "crowd_vs_idle_p99": ratio,
+            "shed": shed[n],
+            "errors": counters["errors"],
+            "expired": counters["expired"],
+            "swaps": counters["swaps"],
+            "isolated": bool(isolated),
+        }
+        print(f"# {n}: idle_p99={idle_p99} ms, crowd_p99={crowd_p99} ms, "
+              f"ratio={ratio}, shed={shed[n]}, swaps={counters['swaps']}",
+              flush=True)
+
+    crowd_row = per_tenant[crowd_tenant]
+    crowd_sheds = crowd_row["shed"] > 0
+    zero_errors = not errors and all(
+        per_tenant[n]["errors"] == 0 for n in names)
+    swaps_ok = per_tenant[swap_tenant]["swaps"] >= 3
+    passed = bool(crowd_sheds and isolation_ok and zero_errors and swaps_ok)
+
+    results = {
+        "bench": "fleet",
+        "tenants": n_tenants,
+        "users": USERS,
+        "engine": engine,
+        "zipf_s": zipf_s,
+        "service_ms": service_ms,
+        "background_qps": total_qps,
+        "crowd_qps": crowd_qps,
+        "crowd_tenant": crowd_tenant,
+        "swap_tenant": swap_tenant,
+        "isolation_factor": factor,
+        "per_tenant": per_tenant,
+        "scheduler": d["fleet"]["scheduler"],
+        "hot_swaps": per_tenant[swap_tenant]["swaps"],
+        "checks": {
+            "crowd_tenant_sheds": bool(crowd_sheds),
+            "others_p99_isolated": bool(isolation_ok),
+            "zero_request_errors": bool(zero_errors),
+            "hot_swaps_under_traffic": bool(swaps_ok),
+        },
+        "pass": passed,
+    }
+    if errors:
+        results["error_sample"] = [list(e) for e in errors[:5]]
+    out = os.path.join(ROOT, "BENCH_FLEET.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=2, sort_keys=True)
+    print(json.dumps(results))
+    raise SystemExit(0 if passed else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -43,11 +43,16 @@ def no_leaked_threads():
     """Fail any test that leaves a NON-DAEMON thread running: a leaked
     worker would hang interpreter shutdown (daemon threads — the serving
     batcher, snapshot watchers, ThreadingHTTPServer handlers — are
-    allowed but are expected to be stopped by the test itself)."""
+    allowed but are expected to be stopped by the test itself). Fleet
+    scheduler workers ("serving-fleet*") are daemons but held to the
+    same standard: a leaked one keeps scoring tenants across tests, so
+    it fails the test too."""
     before = {t.ident for t in threading.enumerate()}
     yield
-    leaked = [t for t in threading.enumerate()
-              if t.ident not in before and not t.daemon and t.is_alive()]
+    fresh = [t for t in threading.enumerate()
+             if t.ident not in before and t.is_alive()]
+    leaked = [t for t in fresh
+              if not t.daemon or t.name.startswith("serving-fleet")]
     if leaked:
         # give naturally-finishing threads a grace period before failing
         deadline = 2.0 / max(len(leaked), 1)
@@ -55,4 +60,4 @@ def no_leaked_threads():
             t.join(timeout=deadline)
         leaked = [t for t in leaked if t.is_alive()]
     assert not leaked, (
-        f"test leaked non-daemon thread(s): {[t.name for t in leaked]}")
+        f"test leaked thread(s): {[t.name for t in leaked]}")

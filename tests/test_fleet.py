@@ -1,0 +1,305 @@
+"""Multi-tenant serving fleet (lightgbm_tpu/serving/fleet.py): per-tenant
+isolation (queues, admission, breakers, metrics), EDF continuous batching
+over one shared worker, hot-swap under traffic, fatal fail-fast, and the
+fleet HTTP front-end. All CPU-runnable tier-1."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import (ModelFleet, RateLimitedError,
+                                  RequestTimeout, ShedError)
+
+COLS = 8
+
+
+def _make(rng, n=400, objective="regression", rounds=8, seed_col=0):
+    X = rng.normal(size=(n, COLS))
+    y = X[:, seed_col] * 2 + 0.1 * rng.normal(size=n)
+    return lgb.train(dict(objective=objective, num_leaves=15, verbose=-1,
+                          min_data_in_leaf=5),
+                     lgb.Dataset(X, label=y), num_boost_round=rounds), X
+
+
+@pytest.fixture(scope="module")
+def models():
+    rng = np.random.RandomState(7)
+    a, X = _make(rng, seed_col=0)
+    b, _ = _make(rng, seed_col=1)
+    c, _ = _make(rng, seed_col=2, rounds=16)
+    return {"a": a, "b": b, "c": c, "X": X}
+
+
+def _fleet(**kw):
+    kw.setdefault("max_batch", 64)
+    kw.setdefault("max_wait_ms", 1.0)
+    kw.setdefault("timeout_ms", 3000.0)
+    kw.setdefault("session_opts", {"engine": "binned"})
+    return ModelFleet(**kw)
+
+
+def test_fleet_correctness_and_metrics(models):
+    X = models["X"]
+    with _fleet() as fleet:
+        fleet.add_model("alpha", models["a"])
+        fleet.add_model("beta", models["b"])
+        pa = fleet.predict(X[:33], tenant="alpha")
+        pb = fleet.predict(X[:33], tenant="beta")
+        assert np.allclose(pa, models["a"].predict(X[:33]))
+        assert np.allclose(pb, models["b"].predict(X[:33]))
+        d = fleet.metrics_dict()
+        tenants = d["fleet"]["tenants"]
+        assert sorted(tenants) == ["alpha", "beta"]
+        # per-tenant namespace: each tenant's QPS / latency / counters
+        # come from ITS metrics object, tagged with its name
+        assert tenants["alpha"]["tenant"] == "alpha"
+        assert tenants["alpha"]["counters"]["requests"] == 1
+        assert tenants["beta"]["counters"]["requests"] == 1
+        assert tenants["alpha"]["request_latency"]["count"] == 1
+        # per-tenant device time from the tagged profiler spans
+        assert sorted(d["stages_by_tenant"]) == ["alpha", "beta"]
+        assert d["fleet"]["scheduler"]["batches"] == 2
+        assert d["fleet"]["scheduler"]["served"] == {"alpha": 1, "beta": 1}
+
+
+def test_fleet_concurrent_tenants(models):
+    X = models["X"]
+    with _fleet() as fleet:
+        for name in ("a", "b", "c"):
+            fleet.add_model(name, models[name])
+        errs = []
+
+        def hammer(name):
+            ref = models[name]
+            for i in range(40):
+                lo = (7 * i) % 300
+                out = fleet.predict(X[lo:lo + 3], tenant=name,
+                                    client=f"c{i % 4}")
+                if not np.allclose(out, ref.predict(X[lo:lo + 3])):
+                    errs.append((name, i))
+
+        threads = [threading.Thread(target=hammer, args=(n,))
+                   for n in ("a", "b", "c")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        d = fleet.metrics_dict()
+        for n in ("a", "b", "c"):
+            assert d["fleet"]["tenants"][n]["counters"]["requests"] == 40
+            assert d["fleet"]["tenants"][n]["counters"]["errors"] == 0
+
+
+def test_tenant_rate_limit_isolation(models):
+    """A flash crowd on one tenant sheds at ITS token bucket; the quiet
+    tenant keeps its full SLO (zero shed, all requests served)."""
+    X = models["X"]
+    with _fleet() as fleet:
+        fleet.add_model("crowd", models["a"],
+                        admission_opts={"rate_qps": 20.0, "burst": 5.0})
+        fleet.add_model("quiet", models["b"])
+        shed = served = 0
+        for i in range(60):
+            try:
+                fleet.predict(X[i:i + 1], tenant="crowd", client="one")
+                served += 1
+            except RateLimitedError:
+                shed += 1
+        assert shed > 0 and served > 0
+        for i in range(20):
+            fleet.predict(X[i:i + 1], tenant="quiet")   # must not raise
+        d = fleet.metrics_dict()["fleet"]["tenants"]
+        assert d["crowd"]["counters"]["shed_rate_limit"] == shed
+        assert d["quiet"]["counters"]["shed_rate_limit"] == 0
+        assert d["quiet"]["counters"]["requests"] == 20
+        assert d["quiet"]["counters"]["errors"] == 0
+
+
+def test_tenant_breaker_isolation(models):
+    """Injected scoring failures on one tenant trip ITS breaker (device
+    -> host degradation, requests still answered); the other tenant's
+    breaker stays closed and its accel path keeps scoring."""
+    from lightgbm_tpu.runtime.faults import FaultPlan
+    X = models["X"]
+    with _fleet(breaker_opts={"failure_threshold": 2}) as fleet:
+        # times=2 == failure_threshold: the accel path fails until the
+        # breaker trips, then the exhausted plan leaves the host
+        # fallback clean (fail_score is engine-agnostic by design)
+        fleet.add_model(
+            "sick", models["a"],
+            fault_plan=FaultPlan.parse("fail_score@batch=0:times=2"))
+        fleet.add_model("healthy", models["b"])
+        for i in range(6):
+            out = fleet.predict(X[i:i + 8], tenant="sick")
+            assert np.allclose(out, models["a"].predict(X[i:i + 8]))
+            fleet.predict(X[i:i + 8], tenant="healthy")
+        d = fleet.metrics_dict()["fleet"]["tenants"]
+        assert d["sick"]["counters"]["host_fallbacks"] >= 2
+        assert d["sick"]["counters"]["breaker_trips"] >= 1
+        assert d["sick"]["counters"]["errors"] == 0      # rescued, not failed
+        assert d["healthy"]["counters"]["host_fallbacks"] == 0
+        assert d["healthy"]["counters"]["breaker_trips"] == 0
+        states = d["sick"].get("states", {})
+        assert states.get("breaker") in ("open", "half_open", "closed")
+
+
+def test_hot_swap_under_traffic(models):
+    """Three promotes on one tenant while both tenants take traffic:
+    zero request errors, versions advance, neighbors untouched."""
+    X = models["X"]
+    with _fleet() as fleet:
+        fleet.add_model("hot", models["a"])
+        fleet.add_model("cold", models["b"])
+        stop = threading.Event()
+        errs = []
+
+        def hammer(name):
+            i = 0
+            while not stop.is_set():
+                try:
+                    fleet.predict(X[i % 300:(i % 300) + 2], tenant=name)
+                except Exception as e:
+                    errs.append((name, repr(e)))
+                i += 1
+
+        threads = [threading.Thread(target=hammer, args=(n,))
+                   for n in ("hot", "cold")]
+        for t in threads:
+            t.start()
+        try:
+            for new_model in (models["b"], models["c"], models["a"]):
+                fleet.promote("hot", new_model)
+                time.sleep(0.05)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert not errs
+        assert fleet.session("hot").version == 3
+        assert fleet.session("cold").version == 0
+        d = fleet.metrics_dict()["fleet"]["tenants"]
+        assert d["hot"]["counters"]["swaps"] == 3
+        assert d["cold"]["counters"]["swaps"] == 0
+        # and the promoted model actually serves
+        assert np.allclose(fleet.predict(X[:5], tenant="hot"),
+                           models["a"].predict(X[:5]))
+
+
+def test_deadline_expiry_at_assembly(models):
+    """A request whose deadline passes while queued is failed at batch
+    assembly (expired counter), never scored."""
+    X = models["X"]
+    fleet = _fleet(fault_plan=__import__(
+        "lightgbm_tpu.runtime.faults", fromlist=["FaultPlan"]
+    ).FaultPlan.parse("wedge_worker@batch=0:ms=300"))
+    fleet.add_model("t", models["a"])
+    fleet.start()
+    try:
+        req = fleet.submit(X[:1], tenant="t",
+                           deadline=time.perf_counter() + 0.02)
+        with pytest.raises(RequestTimeout):
+            fleet.wait(req, tenant="t", timeout=2.0)
+        deadline = time.time() + 2.0
+        while time.time() < deadline:
+            if fleet._tenant("t").metrics.counters["expired"] == 1:
+                break
+            time.sleep(0.01)
+        assert fleet._tenant("t").metrics.counters["expired"] == 1
+    finally:
+        fleet.stop()
+
+
+def test_fatal_worker_death_fails_fast(models):
+    """An error escaping the per-batch guard fails every queued request
+    across all tenants and makes subsequent submits fail fast."""
+    X = models["X"]
+    fleet = _fleet()
+    fleet.add_model("t1", models["a"])
+    fleet.add_model("t2", models["b"])
+
+    def boom():
+        raise RuntimeError("scheduler exploded")
+
+    fleet._next_batch = boom
+    fleet.start()
+    deadline = time.time() + 2.0
+    while time.time() < deadline and fleet._fatal is None:
+        time.sleep(0.01)
+    assert fleet._fatal is not None
+    for tenant in ("t1", "t2"):
+        with pytest.raises(RuntimeError, match="fleet worker died"):
+            fleet.submit(X[:1], tenant=tenant)
+    fleet.stop()
+    assert not fleet.alive()
+
+
+def test_fleet_stop_thread_hygiene(models):
+    """stop() joins the scheduler and fails stragglers; the conftest
+    leak guard (which covers serving-fleet daemon threads) enforces the
+    rest."""
+    fleet = _fleet()
+    fleet.add_model("t", models["a"])
+    fleet.start()
+    assert fleet.alive()
+    fleet.stop()
+    assert not any(t.name.startswith("serving-fleet")
+                   for t in threading.enumerate())
+
+
+def test_fleet_http_server(models, tmp_path):
+    """The fleet HTTP front-end: per-tenant routes, X-Model header,
+    unknown-tenant 404, /metrics per-tenant table."""
+    import types
+
+    from lightgbm_tpu.cli import build_fleet_http_server
+    X = models["X"]
+    cfg = types.SimpleNamespace(serve_host="127.0.0.1", serve_port=0,
+                                serve_deadline_header="X-Deadline-Ms",
+                                serve_deadline_ms=0.0)
+    with _fleet() as fleet:
+        fleet.add_model("alpha", models["a"])
+        fleet.add_model("beta", models["b"])
+        server = build_fleet_http_server(cfg, fleet)
+        host, port = server.server_address
+        st = threading.Thread(target=server.serve_forever, daemon=True)
+        st.start()
+        try:
+            def req(path, data=None, headers=None):
+                r = urllib.request.Request(
+                    f"http://{host}:{port}{path}", data=data,
+                    headers=headers or {})
+                try:
+                    with urllib.request.urlopen(r, timeout=10) as resp:
+                        return resp.status, json.loads(resp.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read())
+
+            body = json.dumps({"rows": X[:3].tolist()}).encode()
+            code, out = req("/predict/alpha", body)
+            assert code == 200
+            assert np.allclose(out["predictions"],
+                               models["a"].predict(X[:3]))
+            code, out = req("/predict", body, {"X-Model": "beta"})
+            assert code == 200
+            assert np.allclose(out["predictions"],
+                               models["b"].predict(X[:3]))
+            code, out = req("/predict/nope", body)
+            assert code == 404
+            code, out = req("/metrics")
+            assert code == 200
+            assert sorted(out["fleet"]["tenants"]) == ["alpha", "beta"]
+            code, out = req("/healthz")
+            assert code == 200
+            code, out = req("/readyz")
+            assert code == 200 and out["tenants"] == ["alpha", "beta"]
+        finally:
+            server.shutdown()
+            server.server_close()
+            st.join(timeout=5.0)

@@ -151,3 +151,24 @@ def test_continued_training_with_linear_init_model():
                    init_model=b)
     l2_b = float(np.mean((yte - b2.predict(Xte)) ** 2))
     assert l2_b < l2_a, (l2_b, l2_a)
+
+
+def test_pred_contrib_fails_loudly_on_linear_trees():
+    """TreeSHAP over constant leaves cannot attribute a linear leaf's
+    within-leaf term: pred_contrib must raise a clear ValueError naming
+    the gap, never return plausible-looking non-SHAP numbers (README.md
+    "Known gaps"); plain trees keep working."""
+    X, y, _, _ = _linear_data(seed=11, n=800)
+    ds = lgb.Dataset(X, label=y)
+    b = lgb.train({**PARAMS, "linear_tree": True}, ds, num_boost_round=4)
+    with pytest.raises(ValueError, match="linear trees"):
+        b.predict(X[:8], pred_contrib=True)
+    # the error names at least one offending tree index
+    with pytest.raises(ValueError, match=r"tree\(s\) \[0"):
+        b.predict(X[:8], pred_contrib=True)
+    plain = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=4)
+    contrib = plain.predict(X[:8], pred_contrib=True)
+    assert contrib.shape == (8, X.shape[1] + 1)
+    np.testing.assert_allclose(contrib.sum(axis=1),
+                               plain.predict(X[:8], raw_score=True),
+                               rtol=1e-6, atol=1e-6)

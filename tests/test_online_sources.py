@@ -1,0 +1,157 @@
+"""Arrow / Sequence micro-batch sources (lightgbm_tpu/online/source.py):
+the Dataset ingestion readers (basic.py pyarrow conversion, the Sequence
+out-of-core interface) plugged into the online loop, with the bin-compat
+schema guard in front. All CPU-runnable tier-1."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.basic import Sequence
+from lightgbm_tpu.online import (ArrowSource, SchemaDriftError,
+                                 SequenceSource, TraceSource,
+                                 check_batch_schema, open_source)
+
+COLS = 4
+
+
+def _matrix(rng, n=100):
+    """Rows where column 0 is the label and the rest are features."""
+    mat = rng.normal(size=(n, COLS + 1))
+    mat[:, 0] = np.arange(n, dtype=np.float64)   # label == row index
+    return mat
+
+
+def _drain(src, timeout_s=0.0):
+    batches = []
+    while True:
+        b = src.next_batch(timeout_s)
+        if b is None:
+            break
+        batches.append(b)
+    return batches
+
+
+def _table(mat):
+    pa = pytest.importorskip("pyarrow")
+    return pa.table({f"c{j}": mat[:, j] for j in range(mat.shape[1])})
+
+
+def test_arrow_table_roundtrip_and_seek(rng):
+    mat = _matrix(rng, n=100)
+    src = ArrowSource(_table(mat), batch_rows=32)
+    batches = _drain(src)
+    assert [b.num_rows for b in batches] == [32, 32, 32, 4]
+    assert src.exhausted
+    got = np.concatenate([b.X for b in batches])
+    assert np.array_equal(got, mat[:, 1:])
+    assert np.array_equal(np.concatenate([b.y for b in batches]),
+                          mat[:, 0])
+    # seekable: replay from batch 2 yields the identical tail
+    src2 = ArrowSource(_table(mat), batch_rows=32)
+    src2.seek(2)
+    tail = _drain(src2)
+    assert [b.seq for b in tail] == [2, 3]
+    assert np.array_equal(tail[0].X, batches[2].X)
+    assert np.array_equal(tail[1].y, batches[3].y)
+
+
+def test_arrow_stream_and_weight_column(rng):
+    pa = pytest.importorskip("pyarrow")
+    mat = _matrix(rng, n=60)
+    mat[:, 2] = rng.rand(60) + 0.5               # weights, column 2
+    table = _table(mat)
+    stream = iter(table.to_batches(max_chunksize=20))  # RecordBatches
+    src = ArrowSource(stream, weight_column=2)
+    batches = _drain(src)
+    assert [b.num_rows for b in batches] == [20, 20, 20]
+    # label + weight columns are split OUT of the feature block
+    assert batches[0].X.shape[1] == COLS - 1
+    assert np.array_equal(np.concatenate([b.weight for b in batches]),
+                          mat[:, 2])
+    assert np.array_equal(np.concatenate([b.X for b in batches]),
+                          mat[:, [1, 3, 4]])
+    # a live record-batch stream cannot rewind
+    with pytest.raises(NotImplementedError):
+        src.seek(1)
+    assert isinstance(table.to_batches()[0], pa.RecordBatch)
+
+
+class _Rows(Sequence):
+    """Out-of-core stand-in: materializes slices on demand."""
+
+    batch_size = 16
+
+    def __init__(self, mat):
+        self._mat = mat
+
+    def __len__(self):
+        return len(self._mat)
+
+    def __getitem__(self, idx):
+        return self._mat[idx]
+
+
+def test_sequence_source_batching_and_seek(rng):
+    mat = _matrix(rng, n=50)
+    src = SequenceSource(_Rows(mat))            # batch_rows <- batch_size
+    batches = _drain(src)
+    assert [b.num_rows for b in batches] == [16, 16, 16, 2]
+    assert np.array_equal(np.concatenate([b.X for b in batches]),
+                          mat[:, 1:])
+    src2 = SequenceSource(_Rows(mat), batch_rows=20)
+    src2.seek(2)
+    tail = _drain(src2)
+    assert len(tail) == 1 and tail[0].num_rows == 10
+    assert np.array_equal(tail[0].y, mat[40:, 0])
+    with pytest.raises(TypeError, match="__len__/__getitem__"):
+        SequenceSource(object())
+
+
+def test_schema_guard_rejects_drifted_arrow_batch(rng):
+    """The bin-compat guard sits between ANY source and the window: an
+    Arrow batch with the wrong column count is rejected whole, exactly
+    like a drifted file drop (docs/ONLINE.md skip-and-log policy)."""
+    mat = _matrix(rng, n=40)
+    src = ArrowSource(_table(mat), batch_rows=16)
+    b = src.next_batch()
+    check_batch_schema(b.X, b.y, COLS)          # matching schema: passes
+    with pytest.raises(SchemaDriftError, match="columns"):
+        check_batch_schema(b.X, b.y, COLS + 2)  # frozen schema mismatch
+    wide = ArrowSource(_table(np.hstack([mat, mat[:, :1]])), batch_rows=16)
+    wb = wide.next_batch()
+    with pytest.raises(SchemaDriftError, match="refusing to re-bin"):
+        check_batch_schema(wb.X, wb.y, COLS)
+
+
+def test_open_source_type_dispatch(rng, tmp_path):
+    mat = _matrix(rng, n=30)
+    assert isinstance(open_source(_table(mat)), ArrowSource)
+    assert isinstance(open_source(_Rows(mat)), SequenceSource)
+    ready = SequenceSource(_Rows(mat))
+    assert open_source(ready) is ready          # BatchSource passthrough
+    with pytest.raises(TypeError, match="not a path"):
+        open_source(12345)
+    # str paths keep their existing routing
+    from lightgbm_tpu.online import save_trace
+    path = str(tmp_path / "t.npz")
+    save_trace(path, mat[:, 1:], mat[:, 0])
+    assert isinstance(open_source(path), TraceSource)
+
+
+def test_arrow_source_feeds_online_trainer_guard(rng):
+    """End to end: corrupt one Arrow batch via the fault plan; the
+    source's guard-visible widening makes check_batch_schema reject
+    exactly that batch and pass the rest."""
+    from lightgbm_tpu.runtime.faults import FaultPlan
+    mat = _matrix(rng, n=64)
+    src = ArrowSource(_table(mat), batch_rows=16,
+                      fault_plan=FaultPlan.parse("corrupt_batch@batch=1"))
+    ok, bad = 0, 0
+    for b in _drain(src):
+        try:
+            check_batch_schema(b.X, b.y, COLS)
+            ok += 1
+        except SchemaDriftError:
+            bad += 1
+    assert (ok, bad) == (3, 1)
+    assert src.corrupted_batches == 1

@@ -1,0 +1,225 @@
+"""Binned-domain predict engine (lightgbm_tpu/ops/predict_binned.py):
+bit-identity against the raw-threshold walks by construction, frozen-
+mapper plumbing, and the engine="binned" serving integration.
+
+The bitwise contracts (docs/PARITY.md §Serving):
+ * BinnedModel.predict_margin (host, f64)  == PackedModel.predict_margin
+ * predict_margin_binned     (device, f32) == predict_margin_packed
+ * ServingSession(engine="binned")         == ServingSession(engine="device")
+All CPU-runnable tier-1."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.models.predictor import PackedModel
+from lightgbm_tpu.ops.predict_binned import (BinnedUnavailable,
+                                             build_binned_model,
+                                             mappers_for)
+from lightgbm_tpu.serving import ServingSession
+
+COLS = 10
+
+
+def _md5(a: np.ndarray) -> str:
+    return hashlib.md5(np.ascontiguousarray(a).tobytes()).hexdigest()
+
+
+def _train(rng, n=600, objective="regression", rounds=12, cat_cols=(),
+           **params):
+    X = rng.normal(size=(n, COLS))
+    for c in cat_cols:
+        X[:, c] = rng.randint(0, 12, size=n)
+    # sprinkle NaN + exact zeros so every missing-type branch is walked
+    X[rng.rand(n, COLS) < 0.05] = np.nan
+    X[rng.rand(n, COLS) < 0.05] = 0.0
+    if objective == "multiclass":
+        y = (np.nan_to_num(X[:, 0]) > 0).astype(int) + \
+            (np.nan_to_num(X[:, 1]) > 0.5).astype(int)
+        params.setdefault("num_class", 3)
+    elif objective == "binary":
+        y = (np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1]) > 0)
+        y = y.astype(float)
+    else:
+        y = np.nan_to_num(X[:, 0]) * 2 + 0.1 * rng.normal(size=n)
+    p = dict(objective=objective, num_leaves=15, verbose=-1,
+             min_data_in_leaf=5, **params)
+    if cat_cols:
+        p["categorical_feature"] = list(cat_cols)
+    booster = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=rounds)
+    return booster, X
+
+
+def _query(rng, X, n=257):
+    """Query rows including NaN, zeros, and out-of-range values."""
+    q = rng.normal(scale=2.0, size=(n, COLS))
+    q[rng.rand(n, COLS) < 0.08] = np.nan
+    q[rng.rand(n, COLS) < 0.08] = 0.0
+    m = min(50, n)
+    q[:m] = X[:m]
+    return q
+
+
+def _pack(gbdt):
+    return PackedModel(gbdt.models, gbdt.num_tree_per_iteration)
+
+
+def _assert_binned_bitwise(booster, Xq):
+    """The three bitwise contracts for one model + query block."""
+    import jax
+
+    from lightgbm_tpu.ops.predict import predict_margin_packed
+
+    gbdt = booster._gbdt
+    pm = _pack(gbdt)
+    bm = build_binned_model(pm, mappers_for(gbdt))
+
+    # 1) host: binned walk == raw-threshold walk, bit for bit (f64)
+    host_raw = pm.predict_margin(Xq)
+    host_binned = bm.predict_margin(bm.bin_rows(Xq))
+    assert _md5(host_binned) == _md5(host_raw)
+    assert np.array_equal(host_binned, host_raw)
+
+    # 2) device: binned while_loop walk == packed while_loop walk (f32
+    #    leaf accumulation in both)
+    K = gbdt.num_tree_per_iteration
+    dev_raw = np.asarray(jax.device_get(
+        predict_margin_packed(pm.device_arrays(), Xq, K)))
+    Xb = bm.bin_rows(Xq)
+    dev_binned = np.asarray(jax.device_get(
+        __import__("lightgbm_tpu.ops.predict_binned",
+                   fromlist=["predict_margin_binned"])
+        .predict_margin_binned(bm.device_arrays(), Xb, K)))
+    assert np.array_equal(dev_binned, dev_raw)
+
+    # 3) serving session: engine="binned" == engine="device" end to end
+    s_dev = ServingSession(gbdt, engine="device", warmup=False)
+    s_bin = ServingSession(gbdt, engine="binned", warmup=False)
+    assert s_bin.engine == "binned"
+    out_dev = np.asarray(s_dev.predict(Xq))
+    out_bin = np.asarray(s_bin.predict(Xq))
+    assert _md5(out_bin) == _md5(out_dev)
+    return bm
+
+
+def test_binned_regression_bitwise(rng):
+    booster, X = _train(rng)
+    _assert_binned_bitwise(booster, _query(rng, X))
+
+
+def test_binned_multiclass_bitwise(rng):
+    booster, X = _train(rng, objective="multiclass")
+    _assert_binned_bitwise(booster, _query(rng, X))
+
+
+def test_binned_categorical_bitwise(rng):
+    n = 600
+    X = rng.normal(size=(n, COLS))
+    X[:, 2] = rng.randint(0, 12, size=n)
+    X[:, 5] = rng.randint(0, 8, size=n)
+    # label driven by category membership so the trainer must emit
+    # categorical (bitset) splits, not just numeric ones
+    y = np.where(np.isin(X[:, 2], (1, 4, 7, 9)), 3.0, -3.0) \
+        + np.where(np.isin(X[:, 5], (0, 2, 5)), 1.5, -1.5) \
+        + 0.1 * rng.normal(size=n)
+    booster = lgb.train(
+        dict(objective="regression", num_leaves=15, verbose=-1,
+             min_data_in_leaf=5),
+        lgb.Dataset(X, label=y, categorical_feature=[2, 5]),
+        num_boost_round=12)
+    q = _query(rng, X)
+    q[:, 2] = rng.randint(0, 12, size=len(q))
+    q[:, 5] = rng.randint(0, 8, size=len(q))
+    # unseen + negative categories must route exactly like the raw walk
+    q[5:20, 2] = [99, -3, 17, 42, -1, 1000, 7.7, 3, 0, 11,
+                  np.nan, 2, 5, 8, 13]
+    bm = _assert_binned_bitwise(booster, q)
+    assert bm.num_cat > 0   # the model really used categorical splits
+
+
+def test_binned_zero_as_missing_bitwise(rng):
+    booster, X = _train(rng, zero_as_missing=True)
+    _assert_binned_bitwise(booster, _query(rng, X))
+
+
+def test_binned_unavailable_without_mappers(rng):
+    booster, _ = _train(rng, n=300, rounds=4)
+    pm = _pack(booster._gbdt)
+    with pytest.raises(BinnedUnavailable):
+        build_binned_model(pm, None)
+
+
+def test_loaded_model_falls_back_to_host(rng, tmp_path):
+    """A model reloaded from text has no frozen mappers: engine="binned"
+    must degrade LOUDLY to host, and explicit bin_mappers= restores the
+    binned engine bit-identically."""
+    booster, X = _train(rng, n=300, rounds=5)
+    path = str(tmp_path / "m.txt")
+    booster.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    gbdt = loaded._gbdt
+    assert mappers_for(gbdt) is None
+    sess = ServingSession(gbdt, engine="binned", warmup=False)
+    assert sess.engine == "host"          # fell back, did not lie
+    # hand the trainer's frozen mappers over explicitly
+    mappers = mappers_for(booster._gbdt)
+    sess2 = ServingSession(gbdt, engine="binned", warmup=False,
+                           bin_mappers=mappers)
+    assert sess2.engine == "binned"
+    q = _query(rng, X, n=64)
+    ref = ServingSession(booster._gbdt, engine="device",
+                         warmup=False).predict(q)
+    assert _md5(np.asarray(sess2.predict(q))) == _md5(np.asarray(ref))
+
+
+def test_linear_tree_falls_back_to_host(rng):
+    X = rng.normal(size=(400, COLS))
+    y = X[:, 0] * 2 + X[:, 1]
+    booster = lgb.train(dict(objective="regression", num_leaves=7,
+                             linear_tree=True, verbose=-1),
+                        lgb.Dataset(X, label=y), num_boost_round=4)
+    sess = ServingSession(booster._gbdt, engine="binned", warmup=False)
+    # linear leaves need raw feature values; binned domain can't score them
+    assert sess.engine == "host"
+
+
+def test_binned_breaker_host_rescue(rng):
+    """A failing binned chunk is rescued by the host walk (same
+    degradation contract as engine="device") and counted."""
+    from lightgbm_tpu.runtime.faults import FaultPlan
+    from lightgbm_tpu.serving import CircuitBreaker, ServingMetrics
+
+    booster, X = _train(rng, n=300, rounds=5)
+    metrics = ServingMetrics()
+    sess = ServingSession(
+        booster._gbdt, engine="binned", warmup=False, metrics=metrics,
+        breaker=CircuitBreaker(failure_threshold=2, metrics=metrics),
+        fault_plan=FaultPlan.parse("fail_score@batch=0:times=1"))
+    q = _query(rng, X, n=32)
+    out = np.asarray(sess.predict(q))       # must not raise
+    ref = np.asarray(booster.predict(q))
+    assert np.allclose(out, ref)
+    assert metrics.counters["host_fallbacks"] >= 1
+
+
+def test_registry_promote_carries_mappers(rng, tmp_path):
+    """Hot-swapping to a text snapshot keeps engine="binned" via the
+    carried frozen mappers (registry promote carry)."""
+    from lightgbm_tpu.serving import ModelRegistry
+
+    booster, X = _train(rng, n=300, rounds=5)
+    path = str(tmp_path / "m.txt")
+    booster.save_model(path)
+    reg = ModelRegistry(engine="binned", warmup=False)
+    reg.register("m", booster)
+    assert reg.session("m").engine == "binned"
+    reg.promote("m", path)                 # reloaded text: no own mappers
+    sess = reg.session("m")
+    assert sess.version == 1
+    assert sess.engine == "binned"
+    q = _query(rng, X, n=64)
+    ref = ServingSession(booster._gbdt, engine="device",
+                         warmup=False).predict(q)
+    assert _md5(np.asarray(sess.predict(q))) == _md5(np.asarray(ref))
