@@ -945,10 +945,15 @@ class Booster:
         gbdt_model_text.cpp:262). Handles missing semantics (None/Zero/NaN
         per Tree::NumericalDecision, tree.h:375-407) and categorical bitset
         splits (Tree::CategoricalDecision)."""
-        if any(getattr(t, "is_linear", False) for t in self._gbdt.models):
+        from .models.predictor import (format_tree_indices,
+                                       linear_tree_indices)
+        linear = linear_tree_indices(self._gbdt.models)
+        if linear:
             from .utils.log import log_fatal
             log_fatal("convert_model to C++ is not supported for linear "
-                      "trees")
+                      f"trees: {format_tree_indices(linear)} carry fitted "
+                      "linear leaf functions; retrain with "
+                      "linear_tree=false")
         g = self._gbdt
         lines = ["#include <cmath>", "#include <cstdint>", "",
                  f"// generated by lightgbm_tpu; {len(g.models)} trees"]

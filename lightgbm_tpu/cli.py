@@ -440,12 +440,12 @@ def run_serve_fleet(params: Dict[str, Any], cfg) -> None:
     batch-score through the FIRST tenant; else stdin lines (first
     tenant). With serve_watch set (any non-empty value) every tenant
     watches its own model path as a snapshot prefix."""
+    from .config import parse_serve_models
     from .runtime.faults import active_plan
     from .serving import ModelFleet
-    entries = []
-    for entry in cfg.serve_models.split(","):
-        name, path = entry.split("=", 1)
-        entries.append((name.strip(), path.strip()))
+    # fail-fast parse (duplicates, empty names/paths) — shared with
+    # Config._validate so the CLI and programmatic configs agree
+    entries = parse_serve_models(cfg.serve_models)
     fault_plan = active_plan(cfg.fault_plan)
     fleet = ModelFleet(
         max_batch=cfg.serve_max_batch,
@@ -453,6 +453,7 @@ def run_serve_fleet(params: Dict[str, Any], cfg) -> None:
         queue_depth=cfg.serve_queue_depth,
         timeout_ms=cfg.serve_request_timeout_ms,
         raw_score=cfg.predict_raw_score, fault_plan=fault_plan,
+        fused=cfg.serve_fused, fused_num_shards=cfg.serve_fused_shards,
         session_opts=dict(
             engine=cfg.serve_engine, min_bucket=cfg.serve_min_bucket,
             num_shards=cfg.serve_num_shards, warmup=cfg.serve_warmup,
@@ -790,9 +791,54 @@ def run_online(params: Dict[str, Any], cfg) -> None:
 
 
 def run_convert_model(params: Dict[str, Any], cfg) -> None:
+    """task=convert_model. ``convert_model_language=cpp`` (or "") emits
+    the standalone if-else C++ (Application::ConvertModel);
+    ``convert_model_language=stablehlo`` freezes the model into an
+    AOT-compiled serving artifact directory (export/compile.py,
+    docs/SERVING.md §Compiled serving). The stablehlo path needs the
+    frozen per-feature bin edges, which model text files do not carry —
+    pass ``data=<training file>`` (with the same binning params) and
+    they are re-derived deterministically."""
     if not cfg.input_model:
         log_fatal("task=convert_model requires input_model")
     booster = Booster(model_file=cfg.input_model)
+    if cfg.convert_model_language == "stablehlo":
+        if not cfg.data:
+            log_fatal(
+                "convert_model_language=stablehlo requires data=<training "
+                "file>: models loaded from text carry no frozen BinMapper "
+                "tables, so the bin edges are re-derived from the "
+                "training data (same data + binning params => identical "
+                "bins; docs/SERVING.md §Compiled serving)")
+        from .export.compile import export_model
+        X, y, w, group, names = load_text_file(
+            cfg.data, has_header=cfg.header, label_column=cfg.label_column,
+            weight_column=cfg.weight_column, group_column=cfg.group_column,
+            ignore_column=cfg.ignore_column)
+        ds = Dataset(X, label=y, weight=w, group=group,
+                     feature_name=list(names),
+                     params=dict(params)).construct()
+        h = ds._handle
+        # per-ORIGINAL-feature mappers (handle mappers are inner-indexed)
+        mappers = [None] * (int(max(h.real_feature_index)) + 1
+                            if len(h.real_feature_index) else 0)
+        for inner, orig in enumerate(h.real_feature_index):
+            if inner < len(h.mappers):
+                mappers[orig] = h.mappers[inner]
+        out_dir = cfg.convert_model \
+            if cfg.convert_model not in ("", "gbdt_prediction.cpp") \
+            else "compiled_model"
+        try:
+            export_model(booster, out_dir, bin_mappers=mappers,
+                         max_batch=cfg.serve_max_batch,
+                         min_bucket=cfg.serve_min_bucket,
+                         start_iteration=cfg.start_iteration_predict,
+                         num_iteration=cfg.num_iteration_predict)
+        except ValueError as e:
+            log_fatal(str(e))
+        log_info(f"Finished converting model; compiled artifact saved "
+                 f"to {out_dir}")
+        return
     out = cfg.convert_model if getattr(cfg, "convert_model", "") else \
         "gbdt_prediction.cpp"
     with open(out, "w") as f:

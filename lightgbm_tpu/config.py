@@ -193,6 +193,38 @@ _alias("step_retry_backoff_s", "watchdog_backoff_s")
 _alias("straggler_skew_threshold", "straggler_threshold")
 
 
+def parse_serve_models(spec: str) -> List[tuple]:
+    """Parse ``serve_models="name=path,name=path"`` into an ordered
+    [(tenant, model_path)] list, failing FAST (log_fatal) on a malformed
+    entry, an empty name or path, or a duplicate tenant name — a
+    duplicate would silently shadow the earlier deployment, so the
+    config echoes the offending entry instead (docs/SERVING.md)."""
+    out: List[tuple] = []
+    seen: set = set()
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if "=" not in entry:
+            log_fatal(
+                f"serve_models entry '{entry}' is not 'name=model_path' "
+                "(expected e.g. 'alpha=a.txt,beta=b.txt'; docs/SERVING.md)")
+        name, path = entry.split("=", 1)
+        name, path = name.strip(), path.strip()
+        if not name or not path:
+            log_fatal(
+                f"serve_models entry '{entry}' is not 'name=model_path' "
+                "(expected e.g. 'alpha=a.txt,beta=b.txt'; docs/SERVING.md)")
+        if name in seen:
+            log_fatal(
+                f"serve_models entry '{entry}' duplicates tenant "
+                f"'{name}' — a duplicate silently shadows the earlier "
+                "deployment; tenant names must be unique (docs/SERVING.md)")
+        seen.add(name)
+        out.append((name, path))
+    return out
+
+
 @dataclass
 class Config:
     """All hyperparameters (reference: include/LightGBM/config.h:41).
@@ -344,6 +376,11 @@ class Config:
     serve_host: str = "127.0.0.1"
     serve_warmup: bool = True          # pre-compile the bucket ladder
     serve_num_shards: int = 0          # > 1: shard buckets over devices
+    # fused drain mode: pack every binned-capable tenant's forest into
+    # one cross-tenant supertensor and score mixed-tenant batches in a
+    # single launch (export/fusion.py, docs/SERVING.md §Compiled serving)
+    serve_fused: bool = False
+    serve_fused_shards: int = 0        # > 1: replicate the fused scorer
     serve_watch: str = ""              # model prefix to poll for snapshots
     serve_watch_poll_s: float = 5.0
     serve_metrics_output: str = ""     # write serving metrics JSON here
@@ -629,13 +666,17 @@ class Config:
             log_fatal("serve_admission_occupancy_high should be in "
                       "[0.0, 1.0] (0 disables occupancy shedding)")
         if self.serve_models:
-            for entry in self.serve_models.split(","):
-                if "=" not in entry or not entry.split("=", 1)[0].strip() \
-                        or not entry.split("=", 1)[1].strip():
-                    log_fatal(
-                        f"serve_models entry '{entry.strip()}' is not "
-                        "'name=model_path' (expected e.g. "
-                        "'alpha=a.txt,beta=b.txt'; docs/SERVING.md)")
+            parse_serve_models(self.serve_models)
+        if self.serve_fused_shards < 0:
+            log_fatal("serve_fused_shards should be >= 0 (0 = no "
+                      "replication of the fused scorer)")
+        if self.convert_model_language not in ("", "cpp", "stablehlo"):
+            log_fatal(
+                f"Unknown convert_model_language "
+                f"'{self.convert_model_language}' (supported: 'cpp' — "
+                "standalone C++ source, '' defaults to it — and "
+                "'stablehlo' — AOT-compiled serving artifact, "
+                "docs/SERVING.md §Compiled serving)")
         # online-loop knobs fail fast so a bad flag can't surface
         # mid-stream (docs/ONLINE.md)
         if self.online_window_rows < 1:
@@ -696,6 +737,7 @@ class Config:
         "serve_breaker_failures", "serve_breaker_latency_slo_ms",
         "serve_breaker_latency_trips", "serve_breaker_cooldown_s",
         "serve_admission_occupancy_high", "serve_models",
+        "serve_fused", "serve_fused_shards",
         # online-loop knobs describe the refresh ORCHESTRATION, not the
         # model: every published snapshot must stay byte-identical to
         # the offline one-shot refit/continue on the same data

@@ -1,0 +1,23 @@
+"""Compiled-serving subsystem (docs/SERVING.md §Compiled serving).
+
+``compile``  — AOT exporter: freeze a trained model into a standalone
+serialized-StableHLO artifact directory (``jax.export``), plus the
+in-process serialize->deserialize roundtrip behind
+``ServingSession(engine="compiled")``.
+``runtime``  — deliberately standalone loader for those artifacts (no
+``lightgbm_tpu.models`` / ``engine`` / ``basic`` imports).
+``fusion``   — cross-tenant forest fusion: many tenants' binned forests
+packed into one padded supertensor scored in a single launch with a
+per-row tenant-id operand (the fleet's fused drain mode,
+serving/fleet.py).
+"""
+
+from .compile import export_model, roundtrip_binned_scorer
+from .fusion import FusedForest, FusedScorer, predict_margin_fused
+from .runtime import CompiledModel, load_compiled
+
+__all__ = [
+    "export_model", "roundtrip_binned_scorer",
+    "CompiledModel", "load_compiled",
+    "FusedForest", "FusedScorer", "predict_margin_fused",
+]

@@ -1,0 +1,236 @@
+"""AOT exporter: freeze a trained model into a standalone serialized
+StableHLO artifact (docs/SERVING.md §Compiled serving).
+
+The reference's ``Application::ConvertModel``
+(src/application/application.cpp:289) emits standalone if-else C++ so a
+model can be served with no LightGBM runtime at all. This is that idea
+for the accelerator path: ``export_model`` specializes the binned-domain
+walk (ops/predict_binned.py) to ONE frozen forest via ``jax.export`` —
+the packed tree arrays are closed over and folded into the StableHLO as
+constants, one executable per padded batch bucket (the serving bucket
+ladder, baked in at export time) — and writes an artifact directory that
+``export/runtime.py``'s :class:`CompiledModel` can score from without
+importing ``lightgbm_tpu.models``, ``engine`` or ``basic``.
+
+Each bucket executable maps uint8 bins ``[b, F]`` to BOTH the f32
+margins (bit-identical to ``engine="binned"``) and the i32 leaf indices
+(which the loader accumulates against the artifact's f64 leaf table —
+bit-identical to ``Booster.predict``). See docs/PARITY.md.
+
+``roundtrip_binned_scorer`` is the in-process flavor behind
+``ServingSession(engine="compiled")``: the same export, serialized and
+immediately deserialized, so every compiled-engine score transits the
+exact artifact bytes a converted model would ship.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..models.predictor import format_tree_indices, linear_tree_indices
+from ..ops.predict_binned import (build_binned_model, mappers_for,
+                                  predict_leaves_binned,
+                                  predict_margin_binned)
+from ..utils.log import log_info
+from .runtime import BIN_TABLE, FORMAT, MANIFEST, bucket_for, file_sha256
+
+# transform names the standalone runtime can replay in f64 numpy,
+# bit-identical to each objective's convert_output (objectives/__init__)
+_TRANSFORMS = {
+    "binary": "sigmoid",
+    "multiclassova": "sigmoid",
+    "cross_entropy": "sigmoid",       # sigmoid with slope 1.0
+    "multiclass": "softmax",
+    "poisson": "exp",
+    "gamma": "exp",
+    "tweedie": "exp",
+    "cross_entropy_lambda": "log1p_exp",
+}
+
+
+def _load_gbdt(model):
+    from ..serving.registry import _load_gbdt
+    return _load_gbdt(model)
+
+
+def _check_no_linear_trees(trees, what: str) -> None:
+    linear = linear_tree_indices(trees)
+    if linear:
+        raise ValueError(
+            f"{what} is not supported for linear trees: "
+            f"{format_tree_indices(linear)} carry fitted linear leaf "
+            f"functions of RAW feature values, which the binned domain "
+            f"cannot represent; retrain with linear_tree=false")
+
+
+def _objective_transform(gbdt) -> tuple:
+    obj = getattr(gbdt, "objective", None)
+    if obj is None or not getattr(obj, "need_convert_output", False):
+        return "identity", 0.0
+    name = getattr(obj, "name", "custom")
+    t = _TRANSFORMS.get(name)
+    if t is None:
+        # still exportable: raw margins are exact; only the transformed
+        # predict path refuses, loudly, in the standalone loader
+        return f"unsupported:{name}", 0.0
+    sig = float(getattr(obj.config, "sigmoid", 1.0)) \
+        if t == "sigmoid" and name != "cross_entropy" else 1.0
+    return t, sig
+
+
+def _bucket_ladder(min_bucket: int, max_batch: int) -> List[int]:
+    max_batch = 1 << max(int(max_batch) - 1, 0).bit_length()
+    b = bucket_for(1, max(int(min_bucket), 1), max_batch)
+    ladder = []
+    while b <= max_batch:
+        ladder.append(b)
+        b *= 2
+    return ladder
+
+
+def _export_bucket(bm, K: int, bucket: int, with_leaves: bool):
+    """jax.export the binned walk specialized to one bucket shape, the
+    forest folded in as constants."""
+    import jax
+    from jax import export as jax_export
+
+    pa = bm.device_arrays()
+    T, F = bm.T, bm.num_features
+
+    if with_leaves:
+        def score(Xb):                  # [b, F] u8 -> ([K, b] f32, [b, T])
+            gl = predict_leaves_binned(pa, Xb)
+            lv = pa.leaf_value[gl]
+            return lv.reshape(bucket, T // K, K).sum(axis=1).T, gl
+    else:
+        def score(Xb):                  # [b, F] u8 -> [K, b] f32
+            return predict_margin_binned(pa, Xb, K)
+
+    spec = jax.ShapeDtypeStruct((bucket, F), np.uint8)
+    return jax_export.export(jax.jit(score))(spec)
+
+
+def roundtrip_binned_scorer(bm, K: int, bucket: int) -> Callable:
+    """Serialize -> deserialize -> jit one bucket's exported scorer: the
+    ``engine="compiled"`` per-bucket builder (serving/session.py). Every
+    score transits the exact StableHLO bytes an artifact would ship, so
+    the compiled engine IS the artifact semantics, in process."""
+    import jax
+    from jax import export as jax_export
+
+    exp = _export_bucket(bm, K, bucket, with_leaves=False)
+    return jax.jit(jax_export.deserialize(bytearray(exp.serialize())).call)
+
+
+def _bin_table_arrays(bm) -> dict:
+    """The frozen BinMapper bin-edge tables, flattened into plain numpy
+    arrays the standalone runtime's :class:`~.runtime.BinTable` rebuilds
+    its searchsorted binning from."""
+    from ..data.binning import BIN_TYPE_CATEGORICAL
+    num_feats, num_missing, num_bounds, num_offsets = [], [], [], [0]
+    cat_feats, cat_num_bin, cat_keys, cat_vals, cat_offsets = \
+        [], [], [], [], [0]
+    for f in bm.used_features:
+        mp = bm._mappers[f]
+        if mp.bin_type == BIN_TYPE_CATEGORICAL:
+            keys = sorted(mp.categorical_2_bin)
+            cat_feats.append(f)
+            cat_num_bin.append(int(mp.num_bin))
+            cat_keys.extend(int(k) for k in keys)
+            cat_vals.extend(int(mp.categorical_2_bin[k]) for k in keys)
+            cat_offsets.append(len(cat_keys))
+        else:
+            num_feats.append(f)
+            num_missing.append(int(mp.missing_type))
+            num_bounds.extend(np.asarray(mp.bin_upper_bound,
+                                         np.float64).tolist())
+            num_offsets.append(len(num_bounds))
+    return dict(
+        num_features=np.int64(bm.num_features),
+        num_feats=np.asarray(num_feats, np.int64),
+        num_missing=np.asarray(num_missing, np.int64),
+        num_bounds=np.asarray(num_bounds, np.float64),
+        num_offsets=np.asarray(num_offsets, np.int64),
+        cat_feats=np.asarray(cat_feats, np.int64),
+        cat_num_bin=np.asarray(cat_num_bin, np.int64),
+        cat_keys=np.asarray(cat_keys, np.int64),
+        cat_vals=np.asarray(cat_vals, np.int64),
+        cat_offsets=np.asarray(cat_offsets, np.int64),
+        leaf_value=np.asarray(bm.leaf_value, np.float64),
+    )
+
+
+def export_model(model, out_dir: str, *, bin_mappers: Optional[List] = None,
+                 max_batch: int = 256, min_bucket: int = 8,
+                 start_iteration: int = 0, num_iteration: int = -1) -> dict:
+    """Freeze `model` (Booster / GBDT / model text / path) into a
+    standalone compiled artifact at `out_dir`; returns the manifest.
+
+    Raises ``ValueError`` for linear trees (naming the offending tree
+    indices) and ``BinnedUnavailable`` when no frozen BinMappers are
+    available (models loaded from text: pass ``bin_mappers=``, e.g.
+    re-derived from the training data — cli.py run_convert_model)."""
+    import jax
+
+    gbdt = _load_gbdt(model)
+    _check_no_linear_trees(gbdt.models, "convert_model to stablehlo")
+    K = gbdt.num_tree_per_iteration
+    total_iters = len(gbdt.models) // max(K, 1)
+    end = total_iters if num_iteration <= 0 else min(
+        total_iters, start_iteration + num_iteration)
+    start = min(start_iteration, total_iters)
+    pm = gbdt._packed_model(start, max(end, start))
+    derived = mappers_for(gbdt)
+    bm = build_binned_model(
+        pm, derived if derived is not None else bin_mappers)
+    transform, sigmoid = _objective_transform(gbdt)
+    ladder = _bucket_ladder(min_bucket, max_batch)
+
+    os.makedirs(out_dir, exist_ok=True)
+    files = {}
+
+    def _write(name: str, data: bytes) -> None:
+        from ..runtime.checkpoint import atomic_write_bytes
+        atomic_write_bytes(os.path.join(out_dir, name), data)
+        files[name] = file_sha256(os.path.join(out_dir, name))
+
+    import io
+    buf = io.BytesIO()
+    np.savez(buf, **_bin_table_arrays(bm))
+    _write(BIN_TABLE, buf.getvalue())
+
+    platforms = None
+    for b in ladder:
+        exp = _export_bucket(bm, K, b, with_leaves=True)
+        platforms = list(exp.platforms)
+        _write(f"bucket_{b}.stablehlo", bytes(exp.serialize()))
+
+    manifest = {
+        "format": FORMAT,
+        "K": int(K),
+        "T": int(bm.T),
+        "num_features": int(bm.num_features),
+        "buckets": ladder,
+        "min_bucket": int(ladder[0]),
+        "max_batch": int(ladder[-1]),
+        "avg_div": int(max(end, start) - start) if gbdt.average_output
+                   else 0,
+        "transform": transform,
+        "sigmoid": sigmoid,
+        "num_trees": int(bm.T),
+        "jax_version": jax.__version__,
+        "platforms": platforms,
+        "files": files,
+    }
+    # manifest LAST (atomic): a partially-written artifact never loads
+    from ..runtime.checkpoint import atomic_write_text
+    atomic_write_text(os.path.join(out_dir, MANIFEST),
+                      json.dumps(manifest, indent=2, sort_keys=True))
+    log_info(f"exported compiled model artifact to {out_dir} "
+             f"(buckets={ladder}, {len(files)} payload files, "
+             f"platforms={platforms})")
+    return manifest

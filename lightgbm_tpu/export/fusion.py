@@ -1,0 +1,300 @@
+"""Cross-tenant forest fusion: many tenants' forests in ONE launch
+(docs/SERVING.md §Compiled serving).
+
+The fleet's unfused drain scores one tenant per device batch, so under
+many-tenant zipfian load nearly every batch switches the resident model
+(BENCH_FLEET.json: tenant_switches ~= batches). Fusion removes the
+switch entirely: every fusable tenant's binned forest (BinnedModel,
+ops/predict_binned.py) is packed into one padded SUPERTENSOR —
+
+ * flat node/leaf arrays are the per-tenant arrays concatenated, plus
+   one shared zero leaf for padding;
+ * per-tenant tree tables ``node_start/leaf_start/single_leaf/slot_of
+   [C, Tmax]`` hold ABSOLUTE offsets into the flat arrays, padded tree
+   slots pointing at the zero leaf via the single-leaf fast path (the
+   walk never visits a node of a padded slot);
+
+— and the fused walk takes a per-row TENANT-ID operand: gathering the
+tree tables by ``tid`` turns the per-tenant dispatch into four array
+lookups inside the same lockstep while_loop, so a mixed-tenant batch
+scores in a single launch. Leaf accumulation scatters each tree's leaf
+into its (iteration, class) slot of a ``[n, ItersMax, Kmax]`` buffer
+(slots are unique per tree — no add-order dependence) and reduces over
+the iteration axis with the SAME reshape-sum the per-tenant walk uses,
+reproducing each tenant's f32 margins bit for bit (gated by
+tests/test_fused.py).
+
+:class:`FusedScorer` wraps the supertensor for the fleet: per-tenant
+binning through each tenant's frozen mappers, column-padding to the
+widest tenant, pow2 bucket padding, optional pod replication over the
+``parallel/`` data mesh (rows AND tenant-ids sharded, supertensor
+replicated), and atomic republish on hot-swap (``serving/fleet.py``
+rebuilds on ``promote()``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..utils.log import log_info
+
+
+class FusedForest:
+    """The supertensor: every fusable tenant's bin-domain forest packed
+    into shared flat arrays + per-tenant [C, Tmax] tree tables."""
+
+    def __init__(self, models: "Dict[str, object]") -> None:
+        """`models`: ordered tenant name -> BinnedModel."""
+        if not models:
+            raise ValueError("FusedForest needs at least one tenant")
+        self.names: List[str] = list(models)
+        self.tid_of = {n: i for i, n in enumerate(self.names)}
+        bms = [models[n] for n in self.names]
+        C = len(bms)
+        self.Tmax = max(bm.T for bm in bms)
+        self.Fmax = max(bm.num_features for bm in bms)
+        self.Kmax = max(bm.K for bm in bms)
+        self.K_of = {n: bm.K for n, bm in zip(self.names, bms)}
+        self.W = max(bm.W for bm in bms)
+        self.num_cat = sum(bm.num_cat for bm in bms)
+
+        def cat(field, dtype):
+            return np.concatenate(
+                [np.asarray(getattr(bm, field), dtype) for bm in bms])
+
+        self.split_feature = cat("split_feature", np.int32)
+        self.threshold_bin = cat("threshold_bin", np.int32)
+        self.missing_bin = cat("missing_bin", np.int32)
+        self.default_left = cat("default_left", bool)
+        self.left_child = cat("left_child", np.int32)
+        self.right_child = cat("right_child", np.int32)
+        self.is_cat = cat("is_cat", bool)
+        # one shared zero leaf at the END pads every short tenant's tree
+        # slots: single_leaf routing yields gl == leaf_start == this slot
+        self.leaf_value = np.concatenate(
+            [np.asarray(bm.leaf_value, np.float32) for bm in bms]
+            + [np.zeros(1, np.float32)])
+        self._zero_leaf = len(self.leaf_value) - 1
+        self.cat_bitset = np.zeros((len(self.split_feature), self.W),
+                                   np.uint32)
+        node_off = 0
+        for bm in bms:
+            M = len(bm.split_feature)
+            self.cat_bitset[node_off:node_off + M, :bm.cat_bitset.shape[1]] \
+                = bm.cat_bitset
+            node_off += M
+
+        # slot_of routes tree t of tenant c into (iteration t // K_c,
+        # class t % K_c) of the flat [ItersMax * Kmax] slot buffer;
+        # padded tree slots go to a garbage slot one past the end
+        self.ItersMax = max(bm.T // bm.K for bm in bms)
+        garbage = self.ItersMax * self.Kmax
+        self.node_start = np.zeros((C, self.Tmax), np.int32)
+        self.leaf_start = np.full((C, self.Tmax), self._zero_leaf, np.int32)
+        self.single_leaf = np.ones((C, self.Tmax), bool)
+        self.slot_of = np.full((C, self.Tmax), garbage, np.int32)
+        node_off = leaf_off = 0
+        for c, bm in enumerate(bms):
+            T = bm.T
+            self.node_start[c, :T] = node_off + \
+                np.asarray(bm.node_start[:-1], np.int32)
+            self.leaf_start[c, :T] = leaf_off + \
+                np.asarray(bm.leaf_start[:-1], np.int32)
+            self.single_leaf[c, :T] = np.asarray(bm.single_leaf, bool)
+            t = np.arange(T, dtype=np.int32)
+            self.slot_of[c, :T] = (t // bm.K) * self.Kmax + (t % bm.K)
+            node_off += len(bm.split_feature)
+            leaf_off += len(bm.leaf_value)
+        self._device = None
+
+    def device_arrays(self):
+        """Pinned device copies, uploaded once per supertensor build."""
+        if self._device is None:
+            import jax.numpy as jnp
+            self._device = {
+                "node_start": jnp.asarray(self.node_start),
+                "leaf_start": jnp.asarray(self.leaf_start),
+                "single_leaf": jnp.asarray(self.single_leaf),
+                "slot_of": jnp.asarray(self.slot_of),
+                "split_feature": jnp.asarray(self.split_feature),
+                "threshold_bin": jnp.asarray(self.threshold_bin),
+                "missing_bin": jnp.asarray(self.missing_bin),
+                "default_left": jnp.asarray(self.default_left),
+                "left_child": jnp.asarray(self.left_child),
+                "right_child": jnp.asarray(self.right_child),
+                "leaf_value": jnp.asarray(self.leaf_value),
+                "is_cat": jnp.asarray(self.is_cat),
+                "cat_bitset": jnp.asarray(self.cat_bitset),
+            }
+        return self._device
+
+
+def predict_margin_fused(fa: dict, num_cat: int, W: int, Kmax: int,
+                         ItersMax: int, Xb, tid):
+    """[Kmax, n] f32 margins for a MIXED-tenant batch: Xb [n, Fmax]
+    uint8 bins (each row binned through ITS tenant's mappers), tid [n]
+    i32 tenant ids. The per-tenant tree tables gathered by tid replace
+    the [T]-vector broadcasts of ``predict_margin_binned``; everything
+    else is the same lockstep walk. Leaf accumulation scatters each
+    tree's leaf into its unique (iteration, class) slot, then reduces
+    over iterations with the identical reshape-sum as the per-tenant
+    walk — padded tenants contribute a zero tail, so outputs match each
+    tenant's ``predict_margin_binned`` bitwise."""
+    import jax
+    import jax.numpy as jnp
+
+    n = Xb.shape[0]
+    Xi = Xb.astype(jnp.int32)
+    ns = fa["node_start"][tid]                   # [n, Tmax]
+    ls = fa["leaf_start"][tid]
+    slot = fa["slot_of"][tid]
+    node0 = jnp.where(fa["single_leaf"][tid], -1, 0).astype(jnp.int32)
+
+    def cond(node):
+        return jnp.any(node >= 0)
+
+    def body(node):
+        g = jnp.maximum(node, 0) + ns                        # [n, Tmax]
+        f = fa["split_feature"][g]
+        bv = jnp.take_along_axis(Xi, f, axis=1)
+        is_missing = bv == fa["missing_bin"][g]
+        go_left = jnp.where(is_missing, fa["default_left"][g],
+                            bv <= fa["threshold_bin"][g])
+        if num_cat > 0:
+            words = fa["cat_bitset"][g, jnp.clip(bv >> 5, 0, W - 1)]
+            gl_cat = ((words >> (bv & 31).astype(jnp.uint32)) & 1) == 1
+            go_left = jnp.where(fa["is_cat"][g], gl_cat, go_left)
+        nxt = jnp.where(go_left, fa["left_child"][g],
+                        fa["right_child"][g])
+        return jnp.where(node >= 0, nxt, node)
+
+    node = jax.lax.while_loop(cond, body, node0)
+    gl = ls + ~node                                          # [n, Tmax]
+    lv = fa["leaf_value"][gl]                                # [n, Tmax] f32
+    # unique slot per tree (+1 garbage slot for padded tree slots, whose
+    # leaf is 0.0 anyway), then the per-tenant walk's own reshape-sum
+    buf = jnp.zeros((n, ItersMax * Kmax + 1), jnp.float32)
+    buf = buf.at[jnp.arange(n)[:, None], slot].add(lv)
+    out = buf[:, :-1].reshape(n, ItersMax, Kmax).sum(axis=1)  # [n, Kmax]
+    return out.T
+
+
+class FusedScorer:
+    """One immutable supertensor + its compiled fused scorer. The fleet
+    treats a scorer as a snapshot: hot-swapping any tenant builds a NEW
+    scorer and republishes the reference atomically (a launch in flight
+    finishes on the old supertensor)."""
+
+    def __init__(self, sessions: "Dict[str, object]", *,
+                 max_batch: int = 256, min_bucket: int = 8,
+                 num_shards: int = 0, generation: int = 0,
+                 warmup: bool = True) -> None:
+        """`sessions`: tenant name -> ServingSession whose ``_bm``
+        (binned model) is set — i.e. engine "binned" or "compiled"."""
+        from ..serving.session import bucket_for
+        self.generation = int(generation)
+        self.sessions = dict(sessions)
+        self.forest = FusedForest(
+            {n: s._bm for n, s in sessions.items()})
+        self.max_batch = 1 << max(int(max_batch) - 1, 0).bit_length()
+        self.num_shards = 0
+        self._mesh = None
+        if num_shards > 1:
+            import jax
+            avail = len(jax.devices())
+            shards = 1 << (min(int(num_shards), avail).bit_length() - 1)
+            if shards > 1:
+                from ..parallel import make_data_mesh
+                self._mesh = make_data_mesh(shards)
+                self.num_shards = shards
+        self.min_bucket = bucket_for(
+            max(int(min_bucket), self.num_shards or 1), 1, self.max_batch)
+        self._jit = None
+        self.build_s = 0.0
+        t0 = time.perf_counter()
+        if warmup:
+            self.warmup()
+        self.build_s = time.perf_counter() - t0
+
+    # ------------------------------------------------------------------
+    def _fn(self):
+        if self._jit is None:
+            import jax
+            fa = self.forest.device_arrays()
+            num_cat, W, Kmax, ItersMax = (
+                self.forest.num_cat, self.forest.W, self.forest.Kmax,
+                self.forest.ItersMax)
+
+            def score(Xb, tid):          # [n, Fmax] u8, [n] i32 -> [K, n]
+                return predict_margin_fused(fa, num_cat, W, Kmax,
+                                            ItersMax, Xb, tid)
+
+            if self._mesh is not None:
+                from ..parallel import build_sharded_score_fn
+                self._jit = build_sharded_score_fn(self._mesh, score,
+                                                   extra_row_args=1)
+            else:
+                self._jit = jax.jit(score)
+        return self._jit
+
+    def warmup(self) -> List[int]:
+        """Compile the whole bucket ladder BEFORE the scorer is
+        published, so a supertensor swap never makes live traffic pay a
+        trace."""
+        import jax
+        ladder, b = [], self.min_bucket
+        while b <= self.max_batch:
+            ladder.append(b)
+            b *= 2
+        fn = self._fn()
+        for b in ladder:
+            out = fn(np.zeros((b, self.forest.Fmax), np.uint8),
+                     np.zeros(b, np.int32))
+            jax.block_until_ready(out)
+        log_info(f"fused scorer gen={self.generation} warm: "
+                 f"tenants={len(self.forest.names)} buckets={ladder} "
+                 f"shards={self.num_shards or 1}")
+        return ladder
+
+    # ------------------------------------------------------------------
+    def score_groups(self, groups: "List[Tuple[str, np.ndarray]]") \
+            -> List[np.ndarray]:
+        """Score a mixed-tenant batch in ONE launch. `groups` is a list
+        of (tenant name, raw f64 rows [n_i, F_i]); returns per-group
+        [K_i, n_i] f64 raw margins (f32-accumulated values — bit-
+        identical to each tenant's ``engine="binned"`` session)."""
+        n = sum(g[1].shape[0] for g in groups)
+        from ..serving.session import bucket_for
+        b = bucket_for(n, self.min_bucket, self.max_batch)
+        Xb = np.zeros((b, self.forest.Fmax), np.uint8)
+        tid = np.zeros(b, np.int32)
+        off = 0
+        for name, X in groups:
+            bm = self.sessions[name]._bm
+            m = X.shape[0]
+            Xb[off:off + m, :bm.num_features] = bm.bin_rows(X)
+            tid[off:off + m] = self.forest.tid_of[name]
+            off += m
+        import jax
+        out = np.asarray(jax.device_get(self._fn()(Xb, tid)))   # [Kmax, b]
+        results = []
+        off = 0
+        for name, X in groups:
+            m = X.shape[0]
+            K = self.K_of(name)
+            r = out[:K, off:off + m].astype(np.float64)
+            sess = self.sessions[name]
+            if sess._avg_div:
+                r = r / sess._avg_div
+            results.append(r)
+            off += m
+        return results
+
+    def K_of(self, name: str) -> int:
+        return self.forest.K_of[name]
+
+    def can_serve(self, name: str) -> bool:
+        return name in self.forest.tid_of

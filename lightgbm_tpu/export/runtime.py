@@ -1,0 +1,261 @@
+"""Standalone loader for AOT-compiled model artifacts (docs/SERVING.md
+§Compiled serving).
+
+A compiled artifact directory (written by ``export/compile.py``) is a
+frozen, self-describing serving unit:
+
+ * ``manifest.json``        — format tag, model metadata (K, T, feature
+   count, bucket ladder, output transform), and a sha256 per payload
+   file, written LAST so a partially-written directory never validates;
+ * ``bin_table.npz``        — the frozen BinMapper bin-edge tables
+   (numerical upper bounds + categorical key/value maps) and the f64
+   leaf-value table;
+ * ``bucket_<b>.stablehlo`` — one serialized ``jax.export`` executable
+   per padded batch bucket: uint8 bins ``[b, F]`` in, ``([K, b]`` f32
+   margins, ``[b, T]`` i32 leaf indices``)`` out, with the whole forest
+   folded in as constants.
+
+This module is deliberately STANDALONE: it imports only numpy, json,
+hashlib and (lazily, to execute) jax — never ``lightgbm_tpu.models``,
+``engine`` or ``basic``. A serving box can load it by file path::
+
+    spec = importlib.util.spec_from_file_location("compiled_runtime",
+                                                  ".../export/runtime.py")
+    runtime = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(runtime)
+    model = runtime.CompiledModel.load("artifact_dir/")
+    preds = model.predict(rows)
+
+without pulling in any of the training stack (tests/test_export.py
+proves the forbidden modules stay out of ``sys.modules``).
+
+Parity contracts (docs/PARITY.md §Compiled serving): ``predict`` /
+``score_margin`` accumulate the executable's leaf INDICES against the
+artifact's f64 leaf table with the same numpy reshape-sum as the host
+walk — bit-identical to ``Booster.predict``; ``score_margin_f32``
+returns the executable's own f32 margins — bit-identical to
+``ServingSession(engine="binned")`` (and ``engine="compiled"``).
+"""
+
+import hashlib
+import json
+import os
+
+import numpy as np
+
+FORMAT = "lightgbm-tpu-stablehlo-v1"
+MANIFEST = "manifest.json"
+BIN_TABLE = "bin_table.npz"
+
+# MissingType (models/tree.py; reference include/LightGBM/meta.h)
+_MISSING_NONE, _MISSING_ZERO, _MISSING_NAN = 0, 1, 2
+
+
+def bucket_for(n, min_bucket, max_bucket):
+    """Smallest power-of-two >= n, clamped (serving/session.py twin)."""
+    b = 1 << max(int(n) - 1, 0).bit_length()
+    return max(min_bucket, min(b, max_bucket))
+
+
+def file_sha256(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+class BinTable:
+    """Frozen per-feature binning tables: raw f64 rows -> uint8 bin
+    indices, replicating ``BinnedModel.bin_rows`` (and through it
+    ``BinMapper.value_to_bin``) without importing either."""
+
+    def __init__(self, npz) -> None:
+        self.num_features = int(npz["num_features"])
+        self.numeric = {}            # feat -> (upper_bounds, missing_type)
+        for i, f in enumerate(npz["num_feats"].tolist()):
+            a, b = int(npz["num_offsets"][i]), int(npz["num_offsets"][i + 1])
+            self.numeric[int(f)] = (npz["num_bounds"][a:b],
+                                    int(npz["num_missing"][i]))
+        self.categorical = {}        # feat -> (keys, vals, num_bin)
+        for i, f in enumerate(npz["cat_feats"].tolist()):
+            a, b = int(npz["cat_offsets"][i]), int(npz["cat_offsets"][i + 1])
+            self.categorical[int(f)] = (npz["cat_keys"][a:b],
+                                        npz["cat_vals"][a:b],
+                                        int(npz["cat_num_bin"][i]))
+
+    def bin_rows(self, X):
+        """[n, F] raw f64 -> [n, F] uint8 bins (split-used features only;
+        unused columns stay 0, exactly like the in-process binned
+        engine)."""
+        n = X.shape[0]
+        out = np.zeros((n, self.num_features), np.uint8)
+        for f, (ub, missing_type) in self.numeric.items():
+            col = np.asarray(X[:, f], np.float64)
+            nan_mask = np.isnan(col)
+            num_bin = len(ub)
+            if missing_type == _MISSING_NAN:
+                v = np.where(nan_mask, 0.0, col)
+                bins = np.searchsorted(ub[:-1], v, side="left")
+                bins = np.minimum(bins, num_bin - 2)
+                bins = np.where(nan_mask, num_bin - 1, bins)
+            else:
+                v = np.where(nan_mask, 0.0, col)
+                bins = np.searchsorted(ub, v, side="left")
+                bins = np.minimum(bins, num_bin - 1)
+            out[:, f] = bins.astype(np.uint8)
+        for f, (keys, vals, num_bin) in self.categorical.items():
+            col = np.asarray(X[:, f], np.float64)
+            nanm = np.isnan(col)
+            valid = ~nanm & (col >= 0)
+            iv = np.where(valid, col, 0).astype(np.int64)
+            pos = np.clip(np.searchsorted(keys, iv), 0, len(keys) - 1)
+            hit = valid & (keys[pos] == iv)
+            out[:, f] = np.where(hit, vals[pos], num_bin).astype(np.uint8)
+        return out
+
+
+class CompiledModel:
+    """A loaded compiled-serving artifact: score from the serialized
+    StableHLO executables with no Python model layer at all."""
+
+    def __init__(self, path, manifest, bin_table, leaf_value) -> None:
+        self.path = path
+        self.manifest = manifest
+        self.bins = bin_table
+        self.leaf_value = leaf_value                   # [L] f64
+        self.K = int(manifest["K"])
+        self.T = int(manifest["T"])
+        self.num_features = int(manifest["num_features"])
+        self.avg_div = int(manifest["avg_div"])
+        self.transform = manifest["transform"]
+        self.sigmoid = float(manifest["sigmoid"])
+        self.buckets = [int(b) for b in manifest["buckets"]]
+        self.min_bucket = int(manifest["min_bucket"])
+        self.max_batch = int(manifest["max_batch"])
+        self._fns = {}                                 # bucket -> callable
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def load(cls, path, verify=True):
+        """Load an artifact directory, verifying the sha256 manifest
+        (a tampered or truncated payload fails loudly, not with wrong
+        scores)."""
+        mpath = os.path.join(path, MANIFEST)
+        with open(mpath) as f:
+            manifest = json.load(f)
+        if manifest.get("format") != FORMAT:
+            raise ValueError(
+                f"{mpath}: unknown artifact format "
+                f"{manifest.get('format')!r} (expected {FORMAT!r})")
+        if verify:
+            for name, digest in manifest["files"].items():
+                got = file_sha256(os.path.join(path, name))
+                if got != digest:
+                    raise ValueError(
+                        f"artifact file {name!r} sha256 mismatch "
+                        f"(manifest {digest[:12]}..., file {got[:12]}...)"
+                        " — corrupt or tampered artifact")
+        npz = np.load(os.path.join(path, BIN_TABLE))
+        return cls(path, manifest, BinTable(npz),
+                   np.asarray(npz["leaf_value"], np.float64))
+
+    # ------------------------------------------------------------------
+    def _fn(self, bucket):
+        """Deserialize (once) and jit-wrap the bucket's executable."""
+        fn = self._fns.get(bucket)
+        if fn is None:
+            import jax
+            from jax import export as jax_export
+            with open(os.path.join(self.path,
+                                   f"bucket_{bucket}.stablehlo"),
+                      "rb") as f:
+                exp = jax_export.deserialize(bytearray(f.read()))
+            fn = jax.jit(exp.call)
+            self._fns[bucket] = fn
+        return fn
+
+    def warmup(self):
+        """Pre-execute every bucket so no live request pays a
+        deserialize/compile; returns the bucket ladder."""
+        import jax
+        for b in self.buckets:
+            out = self._fn(b)(np.zeros((b, self.num_features), np.uint8))
+            jax.block_until_ready(out)
+        return list(self.buckets)
+
+    # ------------------------------------------------------------------
+    def _run(self, X):
+        """Chunk/bucket/pad exactly like the serving session; yields
+        (c0, c1, margins_f32 [K, m], leaves_i32 [m, T])."""
+        import jax
+        X = np.ascontiguousarray(np.asarray(X, np.float64))
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        n = X.shape[0]
+        for c0 in range(0, n, self.max_batch):
+            c1 = min(c0 + self.max_batch, n)
+            m = c1 - c0
+            b = bucket_for(m, self.min_bucket, self.max_batch)
+            Xp = np.zeros((b, self.num_features), np.uint8)
+            Xp[:m] = self.bins.bin_rows(X[c0:c1])
+            m32, gl = self._fn(b)(Xp)
+            m32, gl = jax.device_get((m32, gl))
+            yield c0, c1, np.asarray(m32)[:, :m], np.asarray(gl)[:m]
+
+    def score_margin(self, X):
+        """[K, n] f64 raw margins: the executable routes (leaf indices),
+        the f64 leaf table accumulates — bit-identical to
+        ``Booster.predict(raw_score=True)``."""
+        X = np.asarray(X, np.float64)
+        n = X.shape[0] if X.ndim > 1 else 1
+        out = np.empty((self.K, n), np.float64)
+        for c0, c1, _m32, gl in self._run(X):
+            lv = self.leaf_value[gl]                       # [m, T] f64
+            out[:, c0:c1] = lv.reshape(
+                c1 - c0, self.T // self.K, self.K).sum(axis=1).T
+        if self.avg_div:
+            out /= self.avg_div
+        return out
+
+    def score_margin_f32(self, X):
+        """[K, n] f64-cast f32-accumulated margins straight from the
+        executable — bit-identical to ``engine="binned"`` /
+        ``engine="compiled"`` serving sessions."""
+        X = np.asarray(X, np.float64)
+        n = X.shape[0] if X.ndim > 1 else 1
+        out = np.empty((self.K, n), np.float64)
+        for c0, c1, m32, _gl in self._run(X):
+            out[:, c0:c1] = m32.astype(np.float64)
+        if self.avg_div:
+            out /= self.avg_div
+        return out
+
+    def predict(self, X, raw_score=False):
+        """Output shape/semantics — and, on the f64 path, VALUES —
+        match ``Booster.predict`` bitwise."""
+        raw = self.score_margin(X)
+        if not raw_score:
+            raw = self._convert(raw)
+        return raw[0] if raw.shape[0] == 1 else raw.T
+
+    def _convert(self, raw):
+        t = self.transform
+        if t == "identity":
+            return raw
+        if t == "sigmoid":
+            return 1.0 / (1.0 + np.exp(-self.sigmoid * raw))
+        if t == "softmax":
+            e = np.exp(raw - np.max(raw, axis=0, keepdims=True))
+            return e / np.sum(e, axis=0, keepdims=True)
+        if t == "exp":
+            return np.exp(raw)
+        if t == "log1p_exp":
+            return np.log1p(np.exp(raw))
+        raise ValueError(
+            f"artifact objective transform {t!r} is not supported "
+            f"standalone; score with raw_score=True")
+
+
+def load_compiled(path, verify=True):
+    return CompiledModel.load(path, verify=verify)

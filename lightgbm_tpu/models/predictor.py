@@ -284,6 +284,23 @@ class PackedModel:
         return lv.reshape(self.T // self.K, self.K).sum(axis=0)
 
 
+def linear_tree_indices(trees) -> List[int]:
+    """Indices of linear-leaf trees. The paths that must refuse them —
+    the C++ if-else codegen (basic.py dump_model_to_cpp), the stablehlo
+    AOT exporter (export/compile.py), TreeSHAP (models/shap.py) — all
+    name the offending trees in their error, so the fix (retrain with
+    linear_tree=false, or drop the trees) is obvious from the message."""
+    return [i for i, t in enumerate(trees)
+            if getattr(t, "is_linear", False)]
+
+
+def format_tree_indices(linear: List[int]) -> str:
+    """'tree(s) [0, 3, 7]' (first 8, elided beyond) — the shared error
+    phrasing for linear-tree refusals."""
+    return (f"tree(s) {linear[:8]}"
+            f"{'...' if len(linear) > 8 else ''}")
+
+
 def floor_threshold_f32(t64: np.ndarray) -> np.ndarray:
     """The f64 thresholds floored to the largest f32 <= each: for f32
     feature values v, (v <= thr_f64) == (v <= thr_f32floor), so a device

@@ -90,19 +90,16 @@ class BinnedDeviceArrays(NamedTuple):
     W: int
 
 
-def predict_margin_binned(pa: BinnedDeviceArrays, Xb, K: int):
-    """[K, n] f32 margins for Xb [n, F] uint8 bin indices: the same
-    lockstep while_loop walk as ``predict_margin_packed``, with the
-    float compare replaced by an integer bin compare and the missing
-    test collapsed to ONE equality against a precomputed per-node
-    missing bin. Leaf accumulation is the identical f32 reshape-sum, so
-    outputs are bit-identical to the f32 raw walk whenever routing
-    agrees (always, for f32-representable queries)."""
+def predict_leaves_binned(pa: BinnedDeviceArrays, Xb):
+    """[n, T] i32 ABSOLUTE leaf indices (into the flat ``leaf_value``)
+    for Xb [n, F] uint8 bin indices — the routing half of the binned
+    walk, shared by ``predict_margin_binned`` and the AOT exporter
+    (export/compile.py), whose artifacts return these indices so a
+    standalone loader can accumulate against the f64 leaf table."""
     import jax
     import jax.numpy as jnp
 
     n = Xb.shape[0]
-    T = pa.node_start.shape[0]
     Xi = Xb.astype(jnp.int32)
     node0 = jnp.where(pa.single_leaf[None, :], -1, 0) \
         * jnp.ones((n, 1), jnp.int32)
@@ -125,7 +122,20 @@ def predict_margin_binned(pa: BinnedDeviceArrays, Xb, K: int):
         return jnp.where(node >= 0, nxt, node)
 
     node = jax.lax.while_loop(cond, body, node0)
-    gl = pa.leaf_start[None, :] + ~node                      # [n, T]
+    return pa.leaf_start[None, :] + ~node                    # [n, T]
+
+
+def predict_margin_binned(pa: BinnedDeviceArrays, Xb, K: int):
+    """[K, n] f32 margins for Xb [n, F] uint8 bin indices: the same
+    lockstep while_loop walk as ``predict_margin_packed``, with the
+    float compare replaced by an integer bin compare and the missing
+    test collapsed to ONE equality against a precomputed per-node
+    missing bin. Leaf accumulation is the identical f32 reshape-sum, so
+    outputs are bit-identical to the f32 raw walk whenever routing
+    agrees (always, for f32-representable queries)."""
+    n = Xb.shape[0]
+    T = pa.node_start.shape[0]
+    gl = predict_leaves_binned(pa, Xb)                       # [n, T]
     lv = pa.leaf_value[gl]
     return lv.reshape(n, T // K, K).sum(axis=1).T            # [K, n]
 

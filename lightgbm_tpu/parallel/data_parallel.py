@@ -113,7 +113,8 @@ def build_data_parallel_train_fn(mesh: jax.sharding.Mesh,
     return jax.jit(sharded)
 
 
-def build_sharded_score_fn(mesh: jax.sharding.Mesh, score_fn):
+def build_sharded_score_fn(mesh: jax.sharding.Mesh, score_fn,
+                           extra_row_args: int = 0):
     """jit(shard_map) wrapper for data-parallel SERVING scoring: request
     batches shard over the mesh `data` axis, the model (closed over by
     `score_fn` as pinned device arrays) replicates — the inference-side
@@ -121,13 +122,15 @@ def build_sharded_score_fn(mesh: jax.sharding.Mesh, score_fn):
     (per-row scoring is embarrassingly parallel; the reference's
     predictor just OMP-parallelizes rows, application/predictor.hpp).
 
-    `score_fn(X [n, F]) -> [K, n]` per shard; the wrapped fn takes a
-    batch whose row count divides the data-axis size (pad with
+    `score_fn(X [n, F], *extras) -> [K, n]` per shard; the wrapped fn
+    takes a batch whose row count divides the data-axis size (pad with
     `pad_rows_to`) and returns the full [K, n] on the host mesh.
+    `extra_row_args` extra PER-ROW 1-D operands (e.g. the fused scorer's
+    tenant-id vector, export/fusion.py) shard along the same axis.
     """
     sharded = shard_map_compat(
         score_fn, mesh=mesh,
-        in_specs=(P(DATA_AXIS, None),),
+        in_specs=(P(DATA_AXIS, None),) + (P(DATA_AXIS),) * extra_row_args,
         out_specs=P(None, DATA_AXIS),
         check_vma=False)
     return jax.jit(sharded)

@@ -22,6 +22,22 @@ rows) happens only while no other tenant has queued work: a lone tenant
 gets the same latency as a dedicated :class:`~.batcher.MicroBatcher`,
 a busy fleet never idles the chip to top up a batch.
 
+``fused=True`` adds the FUSED drain mode (export/fusion.py,
+docs/SERVING.md §Compiled serving): every binned-capable tenant's forest
+is packed into one cross-tenant supertensor, and when the EDF-primary
+tenant is covered by the current :class:`~..export.fusion.FusedScorer`
+the worker assembles a MIXED-tenant batch (still in EDF order, still up
+to ``max_batch`` rows) and scores it in a single launch with a per-row
+tenant-id operand — so serving many tenants stops switching the
+resident program at all. Tenants the supertensor cannot cover (host
+engine, linear leaves) and tenants whose session was hot-swapped after
+the supertensor was built drain unfused, exactly as before, until the
+background "fleet-fused-rebuild" thread republishes a fresh supertensor
+(triggered by :meth:`start`, :meth:`add_model` and :meth:`promote`;
+the swap is atomic and the new scorer is warmed up BEFORE publication).
+A fused-launch failure is delivered to every request of that mixed
+batch — the documented wider blast radius of sharing one launch.
+
 Failure semantics mirror the single-model batcher (docs/ROBUSTNESS.md):
 deadline-expired requests are failed at batch assembly before scoring; a
 scoring error is delivered to exactly the requests of that tenant's
@@ -41,7 +57,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..runtime.profiler import StageProfiler
-from ..utils.log import log_info
+from ..utils.log import log_info, log_warning
 from .admission import AdmissionController
 from .batcher import QueueFullError, RequestTimeout, _Request
 from .breaker import CircuitBreaker
@@ -172,7 +188,8 @@ class ModelFleet:
                  profiler: Optional[StageProfiler] = None,
                  session_opts: Optional[Dict[str, Any]] = None,
                  admission_opts: Optional[Dict[str, Any]] = None,
-                 breaker_opts: Optional[Dict[str, Any]] = None) -> None:
+                 breaker_opts: Optional[Dict[str, Any]] = None,
+                 fused: bool = False, fused_num_shards: int = 0) -> None:
         self.max_batch = max(int(max_batch), 1)
         self.max_wait_s = max(float(max_wait_ms), 0.0) / 1e3
         self.queue_depth = max(int(queue_depth), 1)
@@ -192,13 +209,28 @@ class ModelFleet:
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self._fatal: Optional[BaseException] = None
-        self._last_tenant: Optional[_Tenant] = None
+        self._last_tenant: Optional[Any] = None
         self.last_beat = time.perf_counter()
         # observability: scheduler-level fairness counters
         self.batches = 0
         self.tenant_switches = 0
         self.worker_deaths = 0
         self.batch_sizes: List[int] = []
+        # fused drain mode: cross-tenant supertensor (export/fusion.py),
+        # rebuilt off-worker and republished atomically on hot-swap
+        self.fused = bool(fused)
+        self.fused_num_shards = int(fused_num_shards)
+        self._fused_scorer = None
+        self._fused_dirty = False
+        self._fused_thread: Optional[threading.Thread] = None
+        self._fused_seq = 0
+        # sentinel _last_tenant value: a fused launch keeps ONE resident
+        # program regardless of the tenant mix, but a single-tenant
+        # batch after a fused one re-switches the resident model
+        self._FUSED = object()
+        self.fused_generation = 0
+        self.fused_batches = 0
+        self.fused_rows = 0
 
     # ------------------------------------------------------------------
     # tenant management
@@ -237,12 +269,19 @@ class ModelFleet:
             self._tenants[name] = t
         log_info(f"serving fleet: added tenant {name!r} "
                  f"(engine={registry.session(name).engine})")
+        if self._running:
+            self._mark_fused_dirty()
         return t
 
     def promote(self, name: str, model: Any, **session_opts):
-        """Hot-swap one tenant's model; every other tenant is untouched."""
-        return self._tenant(name).registry.promote(
+        """Hot-swap one tenant's model; every other tenant is untouched.
+        In fused mode the supertensor is rebuilt in the background and
+        republished atomically — until then the promoted tenant drains
+        UNFUSED against its new session (never the stale fused copy)."""
+        sess = self._tenant(name).registry.promote(
             name, model, **session_opts)
+        self._mark_fused_dirty()
+        return sess
 
     def watch_snapshots(self, name: str, model_prefix: str,
                         **kw) -> None:
@@ -278,15 +317,20 @@ class ModelFleet:
         self._thread = threading.Thread(
             target=self._loop, name="serving-fleet-worker", daemon=True)
         self._thread.start()
+        self._mark_fused_dirty()
         return self
 
     def stop(self) -> None:
         with self._cond:
             self._running = False
+            self._fused_dirty = False
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        if self._fused_thread is not None:
+            self._fused_thread.join(timeout=10.0)
+            self._fused_thread = None
         err = RuntimeError("fleet stopped")
         with self._cond:
             tenants = list(self._tenants.values())
@@ -342,6 +386,74 @@ class ModelFleet:
         return self.wait(self.submit(x, tenant=tenant, client=client,
                                      deadline=deadline),
                          tenant=tenant, timeout=timeout)
+
+    # ------------------------------------------------------------------
+    # fused supertensor lifecycle
+    # ------------------------------------------------------------------
+    def _mark_fused_dirty(self) -> None:
+        """Request a supertensor (re)build; coalesces bursts of promotes
+        into one rebuild. The build runs on its own daemon thread so a
+        multi-second pack+warmup never stalls the scoring worker."""
+        if not self.fused:
+            return
+        with self._cond:
+            self._fused_dirty = True
+            if self._fused_thread is not None \
+                    and self._fused_thread.is_alive():
+                return
+            self._fused_thread = threading.Thread(
+                target=self._fused_rebuild_loop,
+                name="fleet-fused-rebuild", daemon=True)
+            self._fused_thread.start()
+
+    def _fused_rebuild_loop(self) -> None:
+        while True:
+            with self._cond:
+                if not self._fused_dirty:
+                    return
+                self._fused_dirty = False
+                names = list(self._tenants)
+                gen = self.fused_generation + 1
+            # snapshot sessions OUTSIDE the fleet lock; only tenants
+            # with a binned model (session._bm) can join the supertensor
+            eligible = {}
+            for n in names:
+                try:
+                    s = self._tenants[n].registry.session(n)
+                except KeyError:
+                    continue
+                if getattr(s, "_bm", None) is not None:
+                    eligible[n] = s
+            scorer = None
+            if eligible:
+                try:
+                    from ..export.fusion import FusedScorer
+                    scorer = FusedScorer(
+                        eligible, max_batch=self.max_batch,
+                        min_bucket=min(s.min_bucket
+                                       for s in eligible.values()),
+                        num_shards=self.fused_num_shards, generation=gen)
+                except BaseException as e:
+                    log_warning(f"fleet: fused supertensor rebuild failed "
+                                f"({e!r}); tenants drain unfused")
+            with self._cond:
+                # atomic republish: a launch in flight finishes on the
+                # old scorer object; new batches see the new one
+                self._fused_scorer = scorer
+                if scorer is not None:
+                    self.fused_generation = scorer.generation
+                self._cond.notify_all()
+            if scorer is not None:
+                log_info(f"fleet: fused supertensor gen={scorer.generation}"
+                         f" live ({len(eligible)}/{len(names)} tenants)")
+
+    def _fusable_locked(self, t: _Tenant, scorer) -> bool:
+        """A tenant drains fused only while the published supertensor
+        was built from its CURRENT session — a hot-swapped tenant falls
+        back to unfused until the rebuild lands (never serves stale)."""
+        return (scorer is not None and scorer.can_serve(t.name)
+                and scorer.sessions[t.name]
+                is t.registry.session(t.name))
 
     # ------------------------------------------------------------------
     # the scheduler
@@ -409,12 +521,57 @@ class ModelFleet:
             self._cond.wait(min(rem, 0.05))
         return batch
 
-    def _next_batch(self) -> Tuple[Optional[_Tenant], List[_Request]]:
+    def _drain_fused_locked(self, scorer) \
+            -> List[Tuple[_Tenant, List[_Request]]]:
+        """One MIXED-tenant device batch: keep taking the EDF-earliest
+        head across all fused-capable tenants until max_batch rows,
+        expiring overdue requests; stop filling the moment a NON-fusable
+        tenant becomes EDF-primary (its single-tenant batch runs next);
+        coalesce only while no tenant has queued work."""
+        groups: List[Tuple[_Tenant, List[_Request]]] = []
+        rows = 0
+        open_t = time.perf_counter()
+        while rows < self.max_batch:
+            now = time.perf_counter()
+            t = self._pick_tenant_locked()
+            if t is None:
+                if rows == 0:
+                    break
+                rem = open_t + self.max_wait_s - now
+                if rem <= 0:
+                    break
+                self._cond.wait(min(rem, 0.05))
+                continue
+            if not self._fusable_locked(t, scorer):
+                break
+            q = t.queue._q
+            r = q[0]                     # pick guarantees a live head
+            if r.deadline is not None and now >= r.deadline:
+                q.popleft()
+                t.queue._expire(r)
+                continue
+            if rows and rows + r.n > self.max_batch:
+                break
+            q.popleft()
+            if groups and groups[-1][0] is t:
+                groups[-1][1].append(r)
+            else:
+                groups.append((t, [r]))
+            rows += r.n
+        return groups
+
+    def _next_batch(self):
+        """(tenant, requests) for a single-tenant batch, or
+        (self._FUSED, (scorer, groups)) for a fused mixed-tenant one."""
         with self._cond:
             t = self._pick_tenant_locked()
             if t is None:
                 self._cond.wait(0.05)
                 return None, []
+            scorer = self._fused_scorer if self.fused else None
+            if scorer is not None and self._fusable_locked(t, scorer):
+                groups = self._drain_fused_locked(scorer)
+                return self._FUSED, (scorer, groups)
             batch = self._drain_locked(t)
         return t, [r for r in batch if not r.abandoned]
 
@@ -451,6 +608,64 @@ class ModelFleet:
         t.batches += 1
         t.last_served = time.perf_counter()
 
+    def _score_fused(self, scorer,
+                     groups: List[Tuple[_Tenant, List[_Request]]]) -> None:
+        """One fused launch for a mixed-tenant batch. The supertensor is
+        the resident program regardless of the tenant mix, so fused
+        launches never count as tenant switches (the sentinel
+        ``_last_tenant`` makes the NEXT single-tenant batch count one).
+        A launch failure is delivered to every request in the batch —
+        the wider blast radius of sharing one launch."""
+        t0 = time.perf_counter()
+        if self._last_tenant is not None \
+                and self._last_tenant is not self._FUSED:
+            self.tenant_switches += 1
+        self._last_tenant = self._FUSED
+        self.batches += 1
+        self.fused_batches += 1
+        live = [(t, [r for r in reqs if not r.abandoned])
+                for t, reqs in groups]
+        live = [(t, reqs) for t, reqs in live if reqs]
+        if not live:
+            return
+        try:
+            seq, self._fused_seq = self._fused_seq, self._fused_seq + 1
+            if self.fault_plan is not None:
+                # same per-launch injected service time as the unfused
+                # path (sessions apply it inside score_margin, which the
+                # fused launch bypasses)
+                self.fault_plan.slow_score(seq)
+                self.fault_plan.fail_score(seq)
+            parts = [(t.name,
+                      reqs[0].x if len(reqs) == 1 else
+                      np.concatenate([r.x for r in reqs], axis=0))
+                     for t, reqs in live]
+            with self.profiler.span("score", tenant="fused"):
+                outs = scorer.score_groups(parts)
+        except BaseException as e:       # whole-batch blast radius
+            for t, reqs in live:
+                t.metrics.inc("errors", len(reqs))
+                for r in reqs:
+                    r.error = e
+                    r.event.set()
+                t.last_served = time.perf_counter()
+            return
+        n_rows = sum(X.shape[0] for _, X in parts)
+        self.batch_sizes.append(n_rows)
+        self.fused_rows += n_rows
+        dt = time.perf_counter() - t0
+        for (t, reqs), (_, X), margins in zip(live, parts, outs):
+            out = np.asarray(scorer.sessions[t.name]._postprocess(
+                margins, self.raw_score))
+            off = 0
+            for r in reqs:
+                r.result = out[off:off + r.n]
+                off += r.n
+                r.event.set()
+            t.metrics.record_batch(dt, X.shape[0])
+            t.batches += 1
+            t.last_served = time.perf_counter()
+
     def _loop(self) -> None:
         batch: List[_Request] = []
         loop_idx = 0
@@ -461,6 +676,13 @@ class ModelFleet:
                     self.fault_plan.wedge_worker(loop_idx)
                 loop_idx += 1
                 tenant, batch = self._next_batch()
+                if tenant is self._FUSED:
+                    scorer, groups = batch
+                    batch = [r for _, reqs in groups for r in reqs]
+                    if groups:
+                        self._score_fused(scorer, groups)
+                    batch = []
+                    continue
                 if tenant is None or not batch:
                     continue
                 self._score(tenant, batch)
@@ -503,6 +725,10 @@ class ModelFleet:
                 "batches": self.batches,
                 "tenant_switches": self.tenant_switches,
                 "worker_deaths": self.worker_deaths,
+                "fused": self.fused,
+                "fused_batches": self.fused_batches,
+                "fused_rows": self.fused_rows,
+                "fused_generation": self.fused_generation,
                 "served": {n: t.batches
                            for n, t in sorted(tenants.items())},
             },

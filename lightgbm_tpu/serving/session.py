@@ -34,6 +34,12 @@ Engines:
    transfer shrinks 8x. Requires frozen mappers (in-process-trained
    models have them; pass ``bin_mappers=`` for loaded ones) — otherwise
    falls back to host loudly.
+ * ``compiled`` — the binned walk, AOT-exported per bucket via
+   ``jax.export`` and round-tripped through StableHLO serialization
+   (export/compile.py roundtrip_binned_scorer): every score transits the
+   exact executable bytes a ``task=convert_model`` artifact ships, so
+   the in-process engine IS the artifact semantics. Same requirements
+   and fallback as ``binned``; outputs bit-identical to it.
  * ``auto``  — device on TPU backends, host elsewhere.
 """
 
@@ -170,18 +176,18 @@ class ServingSession:
 
     # ------------------------------------------------------------------
     def _resolve_engine(self, engine: str) -> str:
-        if engine not in ("auto", "host", "device", "binned"):
+        if engine not in ("auto", "host", "device", "binned", "compiled"):
             raise ValueError(f"unknown serving engine {engine!r}")
         if engine == "host":
             return "host"
-        if engine == "binned":
+        if engine in ("binned", "compiled"):
             from ..ops.predict_binned import (BinnedUnavailable,
                                               build_binned_model)
             try:
                 self._bm = build_binned_model(self._pm, self.bin_mappers)
-                return "binned"
+                return engine
             except BinnedUnavailable as e:
-                log_warning(f"serving: binned engine unavailable ({e}); "
+                log_warning(f"serving: {engine} engine unavailable ({e}); "
                             f"falling back to host")
                 return "host"
         if self._has_linear:
@@ -259,11 +265,22 @@ class ServingSession:
             self._binned_jit = jax.jit(score)
         return self._binned_jit
 
+    def _compiled_scorer(self, bucket: int) -> Callable:
+        """Per-bucket AOT scorer: the binned walk exported via
+        ``jax.export``, serialized, deserialized, and jitted — the
+        in-process twin of a ``task=convert_model`` StableHLO artifact
+        (export/compile.py). One executable per bucket shape (the
+        artifact ladder), cached under (version, "compiled", bucket)."""
+        from ..export.compile import roundtrip_binned_scorer
+        return roundtrip_binned_scorer(self._bm, self.K, bucket)
+
     def _build_scorer(self, bucket: int) -> Callable:
         if self.engine == "device":
             return self._device_scorer(bucket)
         if self.engine == "binned":
             return self._binned_scorer(bucket)
+        if self.engine == "compiled":
+            return self._compiled_scorer(bucket)
         # host entries are trivially warm closures over the packed model;
         # they ride the same cache so hit-rate accounting is uniform
         return self._pm.predict_margin
@@ -285,7 +302,7 @@ class ServingSession:
                 import jax
                 out = fn(np.zeros((b, F), np.float32))
                 jax.block_until_ready(out)
-            elif self.engine == "binned":
+            elif self.engine in ("binned", "compiled"):
                 import jax
                 out = fn(np.zeros((b, self._bm.num_features), np.uint8))
                 jax.block_until_ready(out)
@@ -316,7 +333,7 @@ class ServingSession:
         searchsorted), then score uint8 bins on device — an 8x smaller
         transfer than the f32 path, bit-identical output."""
         import jax
-        fn = self._cache.get((self.version, "binned", b),
+        fn = self._cache.get((self.version, self.engine, b),
                              lambda b=b: self._build_scorer(b))
         m = c1 - c0
         Xp = np.zeros((b, self._bm.num_features), np.uint8)
@@ -344,9 +361,9 @@ class ServingSession:
             m = c1 - c0
             b = bucket_for(m, self.min_bucket, self.max_batch)
             seq, self._n_scored = self._n_scored, self._n_scored + 1
-            # "device" and "binned" are both accelerator paths: breaker-
-            # guarded, host re-score on failure
-            use_accel = self.engine in ("device", "binned")
+            # "device", "binned" and "compiled" are all accelerator
+            # paths: breaker-guarded, host re-score on failure
+            use_accel = self.engine in ("device", "binned", "compiled")
             if use_accel and self.breaker is not None \
                     and not self.breaker.allow():
                 use_accel = False
@@ -361,7 +378,7 @@ class ServingSession:
                     if self.fault_plan is not None:
                         self.fault_plan.fail_score(seq)
                     r = (self._score_binned(X, c0, c1, b)
-                         if self.engine == "binned"
+                         if self.engine in ("binned", "compiled")
                          else self._score_device(X, c0, c1, b))
                     if self.breaker is not None:
                         self.breaker.record_success(

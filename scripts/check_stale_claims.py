@@ -30,7 +30,7 @@ DOC_PATHS = ["README.md", "docs/PERF.md", "docs/PARITY.md",
 BENCH_GLOBS = ["BENCH_EXTRAS.json", "BENCH_r*.json", "BENCH_ROWWISE.json",
                "BENCH_COMM.json", "BENCH_FUSED.json", "BENCH_RESIL.json",
                "BENCH_SLO.json", "BENCH_ONLINE.json", "BENCH_FLEET.json",
-               "BASELINE.json", "MULTICHIP_r*.json"]
+               "BENCH_EXPORT.json", "BASELINE.json", "MULTICHIP_r*.json"]
 REL_TOL = 0.05          # claims are rounded for display (700M vs 680.4M)
 SKIP_BEFORE = "≥≤<>~="  # bound / approximation markers: not measurements
 
@@ -42,8 +42,16 @@ SUFFIX = {"K": 1e3, "M": 1e6, "G": 1e9}
 _RATE_KEY = re.compile(r"per_sec|qps|throughput|speedup|^value$",
                        re.IGNORECASE)
 
+# duration-keyed leaves (p99_ms, phase_s, ...) are excluded from the
+# match pool: doc claims are only ever multipliers or rates, so a
+# latency reading can only *coincidentally* match one — and a bench
+# that publishes per-tenant p50/p99 tables (BENCH_FLEET/BENCH_EXPORT)
+# would otherwise blanket the 1-200 range and blunt the check.
+# `_per_s` keys are rates, not durations, hence the lookbehind.
+_DURATION_KEY = re.compile(r"(_ms|_us|_ns|(?<!_per)_s)$")
 
-def _numeric_leaves(obj, out, groups):
+
+def _numeric_leaves(obj, out, groups, key=None):
     """Collect float leaves into `out`; each dict's rate-like values
     (per_sec / qps / throughput keys) form one group in `groups` —
     speedup claims compare two rates measured in the same record.
@@ -53,18 +61,19 @@ def _numeric_leaves(obj, out, groups):
     if isinstance(obj, bool):
         return
     if isinstance(obj, (int, float)):
-        out.append(float(obj))
+        if key is None or not _DURATION_KEY.search(str(key)):
+            out.append(float(obj))
     elif isinstance(obj, dict):
         own = [float(v) for k, v in obj.items()
                if isinstance(v, (int, float)) and not isinstance(v, bool)
                and _RATE_KEY.search(str(k))]
         if len(own) > 1:
             groups.append(own)
-        for v in obj.values():
-            _numeric_leaves(v, out, groups)
+        for k, v in obj.items():
+            _numeric_leaves(v, out, groups, k)
     elif isinstance(obj, list):
         for v in obj:
-            _numeric_leaves(v, out, groups)
+            _numeric_leaves(v, out, groups, key)
 
 
 def load_bench_values():

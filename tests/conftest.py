@@ -44,15 +44,17 @@ def no_leaked_threads():
     worker would hang interpreter shutdown (daemon threads — the serving
     batcher, snapshot watchers, ThreadingHTTPServer handlers — are
     allowed but are expected to be stopped by the test itself). Fleet
-    scheduler workers ("serving-fleet*") are daemons but held to the
-    same standard: a leaked one keeps scoring tenants across tests, so
-    it fails the test too."""
+    scheduler workers ("serving-fleet*") and fused-supertensor rebuild
+    threads ("fleet-fused*", serving/fleet.py) are daemons but held to
+    the same standard: a leaked one keeps scoring tenants (or compiling
+    supertensors) across tests, so it fails the test too."""
     before = {t.ident for t in threading.enumerate()}
     yield
     fresh = [t for t in threading.enumerate()
              if t.ident not in before and t.is_alive()]
     leaked = [t for t in fresh
-              if not t.daemon or t.name.startswith("serving-fleet")]
+              if not t.daemon
+              or t.name.startswith(("serving-fleet", "fleet-fused"))]
     if leaked:
         # give naturally-finishing threads a grace period before failing
         deadline = 2.0 / max(len(leaked), 1)

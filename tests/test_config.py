@@ -63,3 +63,45 @@ def test_config_to_string_roundtrippable():
     s = Config().to_string()
     assert "[num_leaves: 31]" in s
     assert "[learning_rate: 0.1]" in s
+
+
+def test_serve_models_parsing_fail_fast():
+    """serve_models config parsing (cli.py run_serve_fleet goes through
+    the same parse_serve_models): malformed entries, empty names/paths
+    and duplicate tenants all fail fast, echoing the offending entry."""
+    from lightgbm_tpu.config import parse_serve_models
+    assert parse_serve_models("a=a.txt,b=dir/b.txt") == \
+        [("a", "a.txt"), ("b", "dir/b.txt")]
+    assert parse_serve_models(" a = a.txt , ") == [("a", "a.txt")]
+    with pytest.raises(FatalError, match="'justapath.txt'"):
+        parse_serve_models("a=a.txt,justapath.txt")
+    with pytest.raises(FatalError, match="'=b.txt'"):
+        parse_serve_models("=b.txt")
+    with pytest.raises(FatalError, match="'a='"):
+        parse_serve_models("a=")
+    with pytest.raises(FatalError, match="duplicates tenant 'a'"):
+        parse_serve_models("a=a.txt,b=b.txt,a=other.txt")
+    # resolve_params validation runs the same parser
+    with pytest.raises(FatalError, match="duplicates tenant"):
+        resolve_params({"task": "serve", "serve_models": "a=x,a=y"})
+    cfg = resolve_params({"task": "serve", "serve_models": "a=x,b=y"})
+    assert cfg.serve_models == "a=x,b=y"
+
+
+def test_convert_model_language_validation():
+    """Only '', 'cpp' and 'stablehlo' are accepted; anything else fails
+    fast naming the bad value."""
+    assert resolve_params(
+        {"convert_model_language": "cpp"}).convert_model_language == "cpp"
+    assert resolve_params(
+        {"convert_model_language": "stablehlo"}
+    ).convert_model_language == "stablehlo"
+    with pytest.raises(FatalError, match="'java'"):
+        resolve_params({"convert_model_language": "java"})
+
+
+def test_serve_fused_config():
+    cfg = resolve_params({"serve_fused": "true", "serve_fused_shards": "4"})
+    assert cfg.serve_fused is True and cfg.serve_fused_shards == 4
+    with pytest.raises(FatalError):
+        resolve_params({"serve_fused_shards": "-1"})

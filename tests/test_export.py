@@ -1,0 +1,273 @@
+"""Compiled-serving subsystem (lightgbm_tpu/export/): AOT artifact
+export + standalone load, docs/SERVING.md §Compiled serving.
+
+The bitwise contracts (docs/PARITY.md §Compiled serving):
+ * CompiledModel.predict / score_margin   == Booster.predict (f64 leaf
+   table accumulated against the executable's leaf-index output)
+ * CompiledModel.score_margin_f32         == ServingSession("binned")
+ * ServingSession(engine="compiled")      == ServingSession("binned")
+plus the standalone-loader isolation proof (a subprocess scores from a
+saved artifact with lightgbm_tpu.models / engine / basic never
+imported), sha256 tamper detection, the linear-tree refusal path, and
+the task=convert_model convert_model_language=stablehlo CLI flow.
+All CPU-runnable tier-1."""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.export import export_model, load_compiled
+from lightgbm_tpu.serving import ServingSession
+from lightgbm_tpu.utils.log import FatalError
+
+COLS = 10
+
+
+def _md5(a) -> str:
+    return hashlib.md5(np.ascontiguousarray(np.asarray(a))
+                       .tobytes()).hexdigest()
+
+
+def _train(rng, n=500, objective="regression", rounds=10, cat_cols=(),
+           **params):
+    X = rng.normal(size=(n, COLS))
+    for c in cat_cols:
+        X[:, c] = rng.randint(0, 12, size=n)
+    X[rng.rand(n, COLS) < 0.05] = np.nan
+    X[rng.rand(n, COLS) < 0.05] = 0.0
+    if objective == "multiclass":
+        y = (np.nan_to_num(X[:, 0]) > 0).astype(int) + \
+            (np.nan_to_num(X[:, 1]) > 0.5).astype(int)
+        params.setdefault("num_class", 3)
+    elif objective == "binary":
+        y = (np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1]) > 0)
+        y = y.astype(float)
+    else:
+        y = np.nan_to_num(X[:, 0]) * 2 + 0.1 * rng.normal(size=n)
+    p = dict(objective=objective, num_leaves=15, verbose=-1,
+             min_data_in_leaf=5, **params)
+    if cat_cols:
+        p["categorical_feature"] = list(cat_cols)
+    booster = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=rounds)
+    return booster, X
+
+
+def _query(rng, X, n=77):
+    q = rng.normal(scale=2.0, size=(n, COLS))
+    q[rng.rand(n, COLS) < 0.08] = np.nan
+    q[rng.rand(n, COLS) < 0.08] = 0.0
+    m = min(30, n)
+    q[:m] = X[:m]
+    return q
+
+
+def _assert_artifact_parity(booster, Xq, out_dir):
+    """All three bitwise contracts for one model + query block."""
+    export_model(booster, str(out_dir), max_batch=64)
+    cm = load_compiled(str(out_dir))
+    # f64 path: executable leaf indices + artifact f64 leaf table ==
+    # Booster.predict, bit for bit (transforms included)
+    assert _md5(cm.predict(Xq)) == _md5(booster.predict(Xq))
+    assert _md5(cm.predict(Xq, raw_score=True)) == \
+        _md5(booster.predict(Xq, raw_score=True))
+    # f32 path: executable margins == binned serving session
+    s_bin = ServingSession(booster._gbdt, engine="binned", max_batch=64)
+    assert _md5(cm.score_margin_f32(Xq)) == _md5(s_bin.score_margin(Xq))
+    # in-process engine="compiled" scores through the same serialized
+    # StableHLO bytes: identical to binned, end to end through predict
+    s_cmp = ServingSession(booster._gbdt, engine="compiled", max_batch=64)
+    assert s_cmp.engine == "compiled"
+    assert _md5(s_cmp.score_margin(Xq)) == _md5(s_bin.score_margin(Xq))
+    assert _md5(s_cmp.predict(Xq)) == _md5(s_bin.predict(Xq))
+    return cm
+
+
+def test_artifact_parity_regression_categorical(tmp_path):
+    rng = np.random.RandomState(3)
+    booster, X = _train(rng, cat_cols=(2, 7))
+    _assert_artifact_parity(booster, _query(rng, X), tmp_path / "art")
+
+
+def test_artifact_parity_binary_sigmoid(tmp_path):
+    rng = np.random.RandomState(4)
+    booster, X = _train(rng, objective="binary", sigmoid=1.7)
+    cm = _assert_artifact_parity(booster, _query(rng, X), tmp_path / "art")
+    assert cm.transform == "sigmoid" and cm.sigmoid == pytest.approx(1.7)
+
+
+def test_artifact_parity_multiclass_softmax(tmp_path):
+    rng = np.random.RandomState(5)
+    booster, X = _train(rng, objective="multiclass")
+    cm = _assert_artifact_parity(booster, _query(rng, X), tmp_path / "art")
+    assert cm.transform == "softmax" and cm.K == 3
+
+
+def test_artifact_rf_average_output(tmp_path):
+    rng = np.random.RandomState(6)
+    booster, X = _train(rng, boosting="rf", bagging_freq=1,
+                        bagging_fraction=0.7, feature_fraction=0.9)
+    _assert_artifact_parity(booster, _query(rng, X), tmp_path / "art")
+
+
+def test_standalone_loader_no_model_stack(tmp_path):
+    """A subprocess scores from the saved artifact via runtime.py loaded
+    BY FILE PATH — and proves lightgbm_tpu.models / engine / basic are
+    never imported (the artifact is self-contained)."""
+    rng = np.random.RandomState(7)
+    booster, X = _train(rng)
+    Xq = _query(rng, X, n=23)
+    art = tmp_path / "art"
+    export_model(booster, str(art), max_batch=32)
+    expect = _md5(booster.predict(Xq))
+    np.save(tmp_path / "q.npy", Xq)
+
+    import lightgbm_tpu.export.runtime as rt
+    script = f"""
+import importlib.util, os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+spec = importlib.util.spec_from_file_location(
+    "compiled_runtime", {str(rt.__file__)!r})
+runtime = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(runtime)
+model = runtime.CompiledModel.load({str(art)!r})
+preds = model.predict(np.load({str(tmp_path / 'q.npy')!r}))
+forbidden = [m for m in sys.modules
+             if m in ("lightgbm_tpu", "lightgbm_tpu.models",
+                      "lightgbm_tpu.engine", "lightgbm_tpu.basic")
+             or m.startswith(("lightgbm_tpu.models.",
+                              "lightgbm_tpu.engine.",
+                              "lightgbm_tpu.basic."))]
+assert not forbidden, f"model stack leaked into loader: {{forbidden}}"
+import hashlib
+print(hashlib.md5(np.ascontiguousarray(preds).tobytes()).hexdigest())
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PYTHONPATH", None)  # the loader needs numpy+jax, nothing else
+    out = subprocess.run([sys.executable, "-c", script], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip().splitlines()[-1] == expect
+
+
+def test_artifact_tamper_detection(tmp_path):
+    rng = np.random.RandomState(8)
+    booster, _ = _train(rng, rounds=4)
+    art = tmp_path / "art"
+    export_model(booster, str(art), max_batch=16)
+    manifest = json.loads((art / "manifest.json").read_text())
+    victim = sorted(f for f in manifest["files"]
+                    if f.endswith(".stablehlo"))[0]
+    blob = bytearray((art / victim).read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    (art / victim).write_bytes(bytes(blob))
+    with pytest.raises(ValueError, match="sha256 mismatch"):
+        load_compiled(str(art))
+    # verify=False skips the check (explicit opt-out, e.g. trusted store)
+    load_compiled(str(art), verify=False)
+    # unknown format tag fails loudly too
+    manifest["format"] = "not-a-real-format"
+    (art / "manifest.json").write_text(json.dumps(manifest))
+    with pytest.raises(ValueError, match="unknown artifact format"):
+        load_compiled(str(art))
+
+
+def test_linear_tree_refusal_names_indices(tmp_path):
+    """Both converters refuse linear-tree models LOUDLY, naming the
+    offending tree indices (satellite: basic.py dump_model_to_cpp and
+    the stablehlo exporter share the refusal path)."""
+    rng = np.random.RandomState(9)
+    X = rng.normal(size=(300, COLS))
+    y = X[:, 0] * 2 + 0.1 * rng.normal(size=300)
+    booster = lgb.train(dict(objective="regression", num_leaves=8,
+                             verbose=-1, linear_tree=True,
+                             min_data_in_leaf=10),
+                        lgb.Dataset(X, label=y), num_boost_round=3)
+    with pytest.raises(ValueError, match=r"tree\(s\) \[0") as ei:
+        export_model(booster, str(tmp_path / "art"))
+    assert "linear_tree=false" in str(ei.value)
+    with pytest.raises(FatalError, match=r"tree\(s\) \[0"):
+        booster.dump_model_to_cpp()
+
+
+def test_export_text_model_needs_mappers(tmp_path):
+    """A model loaded from text carries no frozen mappers: export must
+    refuse (BinnedUnavailable) unless bin_mappers= is passed."""
+    from lightgbm_tpu.ops.predict_binned import (BinnedUnavailable,
+                                                 mappers_for)
+    rng = np.random.RandomState(10)
+    booster, X = _train(rng, rounds=5)
+    path = tmp_path / "m.txt"
+    booster.save_model(str(path))
+    loaded = lgb.Booster(model_file=str(path))
+    with pytest.raises(BinnedUnavailable):
+        export_model(loaded, str(tmp_path / "art"))
+    # with the training mappers passed explicitly: full parity again
+    mappers = mappers_for(booster._gbdt)
+    export_model(loaded, str(tmp_path / "art"), bin_mappers=mappers,
+                 max_batch=32)
+    cm = load_compiled(str(tmp_path / "art"))
+    Xq = _query(rng, X, n=19)
+    assert _md5(cm.predict(Xq)) == _md5(booster.predict(Xq))
+
+
+def test_cli_convert_model_stablehlo(tmp_path):
+    """task=convert_model convert_model_language=stablehlo end to end:
+    train via CLI from CSV, convert with the same data/params, score the
+    artifact against Booster.predict bitwise."""
+    from lightgbm_tpu.cli import main
+    rng = np.random.RandomState(11)
+    X = rng.normal(size=(300, 5))
+    y = X[:, 0] * 2 + 0.1 * rng.normal(size=300)
+    train_csv = tmp_path / "train.csv"
+    np.savetxt(train_csv, np.column_stack([y, X]), delimiter="\t",
+               fmt="%.10g")
+    model_txt = tmp_path / "model.txt"
+    common = ["num_leaves=8", "verbosity=-1", "min_data_in_leaf=5"]
+    assert main(["task=train", f"data={train_csv}",
+                 "objective=regression", "num_iterations=6",
+                 f"output_model={model_txt}"] + common) == 0
+    art = tmp_path / "compiled"
+    assert main(["task=convert_model", f"input_model={model_txt}",
+                 "convert_model_language=stablehlo",
+                 f"data={train_csv}", f"convert_model={art}",
+                 "serve_max_batch=32"] + common) == 0
+    booster = lgb.Booster(model_file=str(model_txt))
+    cm = load_compiled(str(art))
+    Xq = rng.normal(size=(21, 5))
+    assert _md5(cm.predict(Xq)) == _md5(booster.predict(Xq))
+
+
+def test_cli_convert_model_stablehlo_requires_data(tmp_path):
+    from lightgbm_tpu.cli import main
+    rng = np.random.RandomState(12)
+    booster, _ = _train(rng, rounds=3)
+    model_txt = tmp_path / "model.txt"
+    booster.save_model(str(model_txt))
+    with pytest.raises(FatalError, match="requires data="):
+        main(["task=convert_model", f"input_model={model_txt}",
+              "convert_model_language=stablehlo"])
+
+
+def test_compiled_engine_fallback_and_warmup(tmp_path):
+    """engine="compiled" on a mapper-less model degrades loudly to host
+    (same contract as binned); warmup pre-builds the whole ladder."""
+    rng = np.random.RandomState(13)
+    booster, X = _train(rng, rounds=5)
+    path = tmp_path / "m.txt"
+    booster.save_model(str(path))
+    sess = ServingSession.from_file(str(path), engine="compiled")
+    assert sess.engine == "host"   # no mappers -> loud fallback
+    s = ServingSession(booster._gbdt, engine="compiled", max_batch=32,
+                       min_bucket=8)
+    ladder = s.warmup()
+    assert ladder == [8, 16, 32]
+    info = s.cache_info()
+    assert info["engine"] == "compiled"
+    assert info["entries"] == len(ladder)

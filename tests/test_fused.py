@@ -1,0 +1,235 @@
+"""Cross-tenant forest fusion (lightgbm_tpu/export/fusion.py) and the
+fleet's fused drain mode (serving/fleet.py, docs/SERVING.md §Compiled
+serving): many tenants' forests packed into one padded supertensor,
+scored in ONE launch with a per-row tenant-id operand — bit-identical
+to each tenant's own ``engine="binned"`` session — plus supertensor
+hot-swap (atomic republish on promote) and pod-replicated sharding.
+All CPU-runnable tier-1 (8-device virtual mesh from conftest)."""
+
+import hashlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.export import FusedScorer
+from lightgbm_tpu.serving import ModelFleet, ServingSession
+
+COLS = 8
+
+
+def _md5(a) -> str:
+    return hashlib.md5(np.ascontiguousarray(np.asarray(a))
+                       .tobytes()).hexdigest()
+
+
+def _train(seed, objective="regression", rounds=8, cols=COLS, **params):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(300, cols))
+    X[rng.rand(300, cols) < 0.05] = np.nan
+    if objective == "multiclass":
+        y = (np.nan_to_num(X[:, 0]) > 0).astype(int) + \
+            (np.nan_to_num(X[:, 1]) > 0.5).astype(int)
+        params.setdefault("num_class", 3)
+    elif objective == "binary":
+        y = (np.nan_to_num(X[:, 0]) > 0).astype(float)
+    else:
+        y = np.nan_to_num(X[:, 0]) * 2 + 0.1 * rng.normal(size=300)
+    return lgb.train(dict(objective=objective, num_leaves=12, verbose=-1,
+                          min_data_in_leaf=5, **params),
+                     lgb.Dataset(X, label=y), num_boost_round=rounds)
+
+
+@pytest.fixture(scope="module")
+def tenants():
+    """Deliberately heterogeneous: different K (1 vs 3), different tree
+    counts, different feature counts — everything the supertensor pads."""
+    return {
+        "reg": _train(21, rounds=10),
+        "bin": _train(22, objective="binary", rounds=6, cols=5),
+        "mc": _train(23, objective="multiclass", rounds=7),
+    }
+
+
+def _sessions(tenants, **kw):
+    return {n: ServingSession(b._gbdt, engine="binned", max_batch=64, **kw)
+            for n, b in tenants.items()}
+
+
+def _queries(seed=5):
+    rng = np.random.RandomState(seed)
+    qs = {"reg": rng.normal(scale=2.0, size=(13, COLS)),
+          "bin": rng.normal(scale=2.0, size=(9, 5)),
+          "mc": rng.normal(scale=2.0, size=(11, COLS))}
+    for q in qs.values():
+        q[rng.rand(*q.shape) < 0.1] = np.nan
+    return qs
+
+
+def _assert_groups_bitwise(scorer, sessions, groups):
+    outs = scorer.score_groups(groups)
+    for (name, X), margins in zip(groups, outs):
+        assert _md5(margins) == _md5(sessions[name].score_margin(X)), name
+
+
+def test_fused_scorer_bitwise_mixed_tenants(tenants):
+    """One fused launch over interleaved heterogeneous tenant groups ==
+    each tenant's own binned session, bit for bit — including a tenant
+    appearing twice in one batch."""
+    sessions = _sessions(tenants)
+    scorer = FusedScorer(sessions, max_batch=64)
+    qs = _queries()
+    assert all(scorer.can_serve(n) for n in tenants)
+    assert scorer.K_of("mc") == 3 and scorer.K_of("reg") == 1
+    _assert_groups_bitwise(scorer, sessions, [
+        ("mc", qs["mc"]), ("reg", qs["reg"]), ("bin", qs["bin"]),
+        ("reg", qs["reg"][:4])])
+    # single-tenant group through the fused path is also exact
+    _assert_groups_bitwise(scorer, sessions, [("bin", qs["bin"])])
+
+
+def test_fused_scorer_sharded_bitwise(tenants):
+    """The pod-replicated flavor (parallel/build_sharded_score_fn with a
+    per-row tenant-id operand) is bit-identical to the unsharded fused
+    launch AND to the per-tenant sessions."""
+    sessions = _sessions(tenants)
+    scorer = FusedScorer(sessions, max_batch=64, num_shards=4)
+    assert scorer.num_shards == 4
+    qs = _queries(6)
+    _assert_groups_bitwise(scorer, sessions, [
+        ("reg", qs["reg"]), ("mc", qs["mc"]), ("bin", qs["bin"])])
+
+
+def _fleet(**kw):
+    kw.setdefault("max_batch", 64)
+    kw.setdefault("max_wait_ms", 1.0)
+    kw.setdefault("timeout_ms", 5000.0)
+    kw.setdefault("session_opts", {"engine": "binned"})
+    kw.setdefault("fused", True)
+    return ModelFleet(**kw)
+
+
+def _wait_fused(fleet, gen=0, names=(), timeout=30.0):
+    """Block until a supertensor generation > `gen` is live and covers
+    every tenant in `names` (add_model while running triggers one
+    rebuild per tenant, so early generations may cover a subset)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        scorer = fleet._fused_scorer
+        if scorer is not None and fleet.fused_generation > gen \
+                and all(scorer.can_serve(n) for n in names):
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"fused supertensor gen>{gen} covering {names} "
+                         f"never published")
+
+
+def test_fleet_fused_cross_tenant_batch(tenants):
+    """Requests from three tenants land in ONE fused scheduler batch
+    (tenant_switches stays 0), with per-tenant results bit-identical to
+    each tenant's own session."""
+    qs = _queries(7)
+    with _fleet(max_wait_ms=100.0) as fleet:
+        for n, b in tenants.items():
+            fleet.add_model(n, b)
+        _wait_fused(fleet, names=tuple(tenants))
+        reqs = {n: fleet.submit(qs[n], tenant=n) for n in tenants}
+        outs = {n: fleet.wait(r, tenant=n, timeout=30.0)
+                for n, r in reqs.items()}
+        for n in tenants:
+            ref = fleet.session(n).predict(qs[n])
+            assert _md5(outs[n]) == _md5(ref), n
+        d = fleet.metrics_dict()["fleet"]["scheduler"]
+        assert d["fused"] is True
+        assert d["fused_batches"] >= 1
+        assert d["fused_rows"] == sum(q.shape[0] for q in qs.values())
+        # one resident fused program: no model switches at all
+        assert d["tenant_switches"] == 0
+        assert sorted(d["served"]) == sorted(tenants)
+
+
+def test_fleet_fused_hot_swap_republish(tenants):
+    """promote() marks the supertensor dirty; the background rebuild
+    republishes a new generation atomically and the promoted tenant's
+    fused scores match its NEW session bitwise. Until the republish the
+    tenant drains unfused (still correct, never the stale fused copy)."""
+    qs = _queries(8)
+    with _fleet() as fleet:
+        for n, b in tenants.items():
+            fleet.add_model(n, b)
+        _wait_fused(fleet, names=tuple(tenants))
+        gen0 = fleet.fused_generation
+        new_model = _train(99, objective="binary", rounds=9, cols=5)
+        fleet.promote("bin", new_model)
+        # correctness during the rebuild window: served unfused from the
+        # new session immediately
+        out = fleet.predict(qs["bin"], tenant="bin")
+        assert _md5(out) == _md5(fleet.session("bin").predict(qs["bin"]))
+        _wait_fused(fleet, gen=gen0)
+        assert fleet.fused_generation > gen0
+        before = fleet.fused_batches
+        out = fleet.predict(qs["bin"], tenant="bin")
+        assert fleet.fused_batches > before     # back on the fused path
+        assert _md5(out) == _md5(fleet.session("bin").predict(qs["bin"]))
+        assert np.allclose(np.asarray(out).ravel(),
+                           new_model.predict(qs["bin"]).ravel())
+
+
+def test_fleet_fused_ineligible_tenant_drains_unfused(tenants, tmp_path):
+    """A tenant whose session has no binned model (text-loaded, no
+    mappers -> host engine) stays OUT of the supertensor; it still
+    serves correctly, unfused, next to fused neighbors."""
+    path = tmp_path / "m.txt"
+    tenants["reg"].save_model(str(path))
+    qs = _queries(9)
+    with _fleet() as fleet:
+        fleet.add_model("fusable", tenants["mc"])
+        fleet.add_model("hosty", lgb.Booster(model_file=str(path)))
+        assert fleet.session("hosty").engine == "host"
+        _wait_fused(fleet, names=("fusable",))
+        assert not fleet._fused_scorer.can_serve("hosty")
+        assert fleet._fused_scorer.can_serve("fusable")
+        out_h = fleet.predict(qs["reg"], tenant="hosty")
+        out_f = fleet.predict(qs["mc"], tenant="fusable")
+        assert _md5(out_h) == _md5(fleet.session("hosty").predict(qs["reg"]))
+        assert _md5(out_f) == _md5(fleet.session("fusable").predict(qs["mc"]))
+        d = fleet.metrics_dict()["fleet"]["scheduler"]
+        assert d["fused_batches"] >= 1          # the fusable tenant fused
+        assert d["batches"] >= 2
+
+
+def test_fleet_tenant_from_model_file_with_mappers(tenants, tmp_path):
+    """Satellite: a fleet tenant deployed from a text model file keeps
+    the full binned engine when the training mappers are passed through
+    ``add_model(bin_mappers=...)`` (the ServingSession(bin_mappers=)
+    path) — and scores bit-identical to the original in-memory model."""
+    from lightgbm_tpu.ops.predict_binned import mappers_for
+    booster = tenants["reg"]
+    path = tmp_path / "m.txt"
+    booster.save_model(str(path))
+    loaded = lgb.Booster(model_file=str(path))
+    qs = _queries(10)
+    ref = ServingSession(booster._gbdt, engine="binned", max_batch=64)
+    with _fleet() as fleet:
+        fleet.add_model("filetenant", loaded,
+                        bin_mappers=mappers_for(booster._gbdt))
+        sess = fleet.session("filetenant")
+        assert sess.engine == "binned"          # mappers made it through
+        _wait_fused(fleet, names=("filetenant",))   # ...and it can even fuse
+        assert fleet._fused_scorer.can_serve("filetenant")
+        out = fleet.predict(qs["reg"], tenant="filetenant")
+        assert _md5(out) == _md5(ref.predict(qs["reg"]))
+
+
+def test_fleet_fused_stop_thread_hygiene(tenants):
+    """stop() joins both the scheduler worker and the fused-rebuild
+    thread; the conftest leak guard covers fleet-fused* daemons too."""
+    fleet = _fleet()
+    fleet.add_model("t", tenants["reg"])
+    fleet.start()
+    _wait_fused(fleet)
+    fleet.stop()
+    assert not any(t.name.startswith(("serving-fleet", "fleet-fused"))
+                   for t in threading.enumerate())

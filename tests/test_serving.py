@@ -539,7 +539,9 @@ def test_http_overload_503_and_health_endpoints(reg_booster):
             for _ in range(3)]
         for th in posters:
             th.start()
-        deadline = time.time() + 5
+        # generous deadline: on a loaded single-core host the poster
+        # threads can take seconds just to get scheduled
+        deadline = time.time() + 20
         while mb.depth < 2 and time.time() < deadline:
             time.sleep(0.01)
         assert mb.depth >= 2
