@@ -146,6 +146,10 @@ def main() -> None:
     # under traffic; pass/fail is per-tenant SLO isolation
     # (scripts/bench_fleet.py, docs/SERVING.md §Multi-tenant fleet);
     # writes BENCH_FLEET.json
+    # BENCH_BATCHED=1: host-free training chunks vs the per-iteration
+    # loop — wall speedup, dispatches/iteration, md5 parity + early-stop
+    # truncation cross-checks (scripts/bench_batched.py, docs/PERF.md
+    # §7); writes BENCH_BATCHED.json
     for env, script in (("BENCH_SERVING", "bench_serving.py"),
                         ("BENCH_ROWWISE", "bench_rowwise.py"),
                         ("BENCH_COMM", "bench_comm.py"),
@@ -153,7 +157,8 @@ def main() -> None:
                         ("BENCH_RESIL", "bench_resilience.py"),
                         ("BENCH_SLO", "bench_slo.py"),
                         ("BENCH_ONLINE", "bench_online.py"),
-                        ("BENCH_FLEET", "bench_fleet.py")):
+                        ("BENCH_FLEET", "bench_fleet.py"),
+                        ("BENCH_BATCHED", "bench_batched.py")):
         if os.environ.get(env, "") not in ("", "0"):
             import runpy
             runpy.run_path(

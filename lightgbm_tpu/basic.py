@@ -639,21 +639,32 @@ class Booster:
                                              np.asarray(hess))
         return self._gbdt.train_one_iter()
 
-    def update_batch(self, n: int, chunk: int = 32) -> None:
+    def update_batch(self, n: int, chunk: Optional[int] = None) -> None:
         """Run `n` boosting iterations with whole-chunk device scans (no
         host round-trip per iteration) when semantics allow, else fall
         back to per-iteration updates. TPU-native extension; the
         reference's per-iteration C API boundary (LGBM_BoosterUpdateOneIter)
-        has no batched analog."""
+        has no batched analog.
+
+        Tail iterations (n % chunk) run through the SAME compiled scan,
+        padded to the chunk size with inert steps, so a single executable
+        covers every chunk regardless of n (docs/PERF.md §7)."""
         if self._gbdt._stopped:
             return
+        if chunk is None:
+            chunk = self._config.batched_chunk_size
         done = 0
         chunks_done = 0
-        if self._gbdt.can_batch_iters(n):
-            n_chunks = n // chunk
-            while n - done >= chunk:
-                self._gbdt.train_iters_batched(chunk)
-                done += chunk
+        if self._gbdt.can_batch_iters(min(n, chunk)):
+            n_chunks = (n + chunk - 1) // chunk
+            while done < n:
+                step = min(chunk, n - done)
+                if not self._gbdt.can_batch_iters(step):
+                    # a host-mode resample falls inside THIS chunk's
+                    # window; finish the remainder per-iteration
+                    break
+                self._gbdt.train_iters_batched(step, n_pad=chunk)
+                done += step
                 chunks_done += 1
                 # amortized no-more-splits check (one sync) at power-of-2
                 # chunk counts, mirroring train_one_iter's policy. The

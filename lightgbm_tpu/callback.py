@@ -45,6 +45,9 @@ def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
             log_info(f"[{env.iteration + 1}]\t{result}")
 
     _callback.order = 10  # type: ignore
+    # pure function of the CallbackEnv: safe to replay per-iteration from
+    # stacked in-scan metric values after a batched chunk (docs/PERF.md §7)
+    _callback.batched_replay = True  # type: ignore
     return _callback
 
 
@@ -65,6 +68,7 @@ def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]) -> Callabl
             eval_result.setdefault(name, {}).setdefault(metric, []).append(value)
 
     _callback.order = 20  # type: ignore
+    _callback.batched_replay = True  # type: ignore
     return _callback
 
 
@@ -194,4 +198,8 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                                          state["best_score_list"][i])
 
     _callback.order = 30  # type: ignore
+    # replay-safe: stopping depends only on the per-iteration eval lists,
+    # and later trees never change earlier metrics — the engine truncates
+    # surplus trees back to the stop point, bit-identical to stopping live
+    _callback.batched_replay = True  # type: ignore
     return _callback

@@ -538,6 +538,18 @@ class Config:
     straggler_skew_threshold: float = 1.5  # flag ranks slower than this
     #                                    multiple of the median grow span
 
+    # -- batched training (models/gbdt.py:train_iters_batched,
+    # docs/PERF.md §7): run boosting in host-free lax.scan chunks with
+    # device-side bagging/GOSS and in-scan valid-set scoring; the engine
+    # replays callbacks per chunk and truncates surplus trees on early
+    # stop, so models stay md5-identical to the per-iteration path.
+    # Env LIGHTGBM_TPU_DISABLE_BATCHED=1 overrides batched_train at
+    # runtime (escape hatch, no config edit needed).
+    batched_train: bool = True
+    batched_chunk_size: int = 32       # iterations per scan launch; tail
+    #                                    chunks pad to this so the scan fn
+    #                                    compiles once per (chunk, shape)
+
     def __post_init__(self) -> None:
         self._validate()
 
@@ -623,6 +635,9 @@ class Config:
             log_fatal("checkpoint_retention should be >= 1")
         if self.step_max_retries < 0:
             log_fatal("step_max_retries should be >= 0")
+        if self.batched_chunk_size < 1:
+            log_fatal("batched_chunk_size should be >= 1 (iterations per "
+                      "host-free scan launch — docs/PERF.md §7)")
         if self.step_retry_backoff_s < 0.0:
             log_fatal("step_retry_backoff_s should be >= 0.0")
         if self.straggler_skew_threshold <= 1.0:
@@ -727,6 +742,10 @@ class Config:
         "checkpoint_interval", "checkpoint_dir", "checkpoint_retention",
         "resume_from_checkpoint", "fault_plan", "step_max_retries",
         "step_retry_backoff_s", "straggler_skew_threshold",
+        # batched-training knobs describe dispatch ORCHESTRATION only:
+        # chunked scans are md5-identical to the per-iteration loop
+        # (tests/test_batched.py), so they must not perturb model files
+        "batched_train", "batched_chunk_size",
         # serving overload-protection knobs describe the SERVING process,
         # not the model; keeping them out preserves the byte-identical
         # model-file contract across config changes

@@ -75,10 +75,13 @@ def train(
     callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
     # -- resilience: iteration checkpointing and crash resume
-    # (runtime/checkpoint.py, docs/ROBUSTNESS.md). Both default off; the
-    # checkpointed/resumed loop must take the per-iteration path below —
-    # the same path for save and resume runs is part of the bit-identical
-    # guarantee — so the batched fast-path is gated on them being off.
+    # (runtime/checkpoint.py, docs/ROBUSTNESS.md). Both default off.
+    # Checkpointing and resume now ride the batched path too: chunk
+    # boundaries are cut to checkpoint-interval multiples, so save points
+    # (and the states they capture) are identical to the per-iteration
+    # loop's — chunked scans are md5-identical to eager iterations
+    # (tests/test_batched.py), which is what keeps the bit-identical
+    # save/resume guarantee intact.
     ckpt_mgr = None
     begin_iter = 0
     if cfg.checkpoint_interval > 0:
@@ -99,17 +102,17 @@ def train(
             log_info(f"checkpoint already holds {begin_iter} iterations "
                      f">= num_boost_round={num_boost_round}; nothing to do")
 
-    # whole-chunk device training when nothing needs per-iteration host
-    # interaction (no callbacks/eval/custom objective): the boosting loop
-    # runs as jitted scans with zero host round-trips
-    if (not callbacks_before and not callbacks_after and fobj is None
-            and feval is None and not valid_contain_train
-            and not booster.name_valid_sets
-            and ckpt_mgr is None and begin_iter == 0
-            and not cfg.resume_from_checkpoint
-            and booster._gbdt.can_batch_iters(num_boost_round)):
-        booster.update_batch(num_boost_round)
-        booster.best_iteration = booster.current_iteration
+    # whole-chunk device training is the DEFAULT: the boosting loop runs
+    # as jitted lax.scan chunks with in-scan bagging/GOSS and valid-set
+    # metrics, and callbacks that declare `batched_replay` (logging,
+    # eval recording, early stopping) are replayed host-side from the
+    # stacked per-iteration metric values after each chunk — no host
+    # round-trip per iteration (docs/PERF.md §7)
+    if _try_batched_train(booster, cfg, params, num_boost_round,
+                          begin_iter, callbacks_before, callbacks_after,
+                          fobj, feval, valid_contain_train, ckpt_mgr):
+        if booster.best_iteration <= 0:
+            booster.best_iteration = booster.current_iteration
         return booster
 
     for it in range(begin_iter, num_boost_round):
@@ -150,6 +153,129 @@ def train(
     if booster.best_iteration <= 0:
         booster.best_iteration = booster.current_iteration
     return booster
+
+
+def _try_batched_train(booster: Booster, cfg, params: Dict[str, Any],
+                       num_boost_round: int, begin_iter: int,
+                       callbacks_before: List[Callable],
+                       callbacks_after: List[Callable],
+                       fobj, feval, valid_contain_train: bool,
+                       ckpt_mgr) -> bool:
+    """Chunked host-free training with callback replay (docs/PERF.md §7).
+
+    Runs the whole boosting loop as fixed-size jitted scans. Valid-set
+    metrics are evaluated INSIDE the scan (stacked per-iteration values
+    come back with the chunk), and replay-safe callbacks are then driven
+    per-iteration from those values — including early stopping, whose
+    stop decision is exact in retrospect because later trees never
+    affect earlier iterations' metrics; surplus trees past the stop
+    point are truncated, yielding the same model as stopping live.
+    Chunk boundaries are cut to checkpoint-interval multiples so save
+    points capture bit-identical states to the per-iteration loop.
+
+    Returns False (without training anything) when some requirement
+    forces the per-iteration path: custom fobj/feval, before-iteration
+    callbacks, a callback without `batched_replay`, training-set eval,
+    a metric with no device analog, or a can_batch_iters() veto
+    (config/env escape hatch, linear trees, host objective, DART/RF,
+    fault injection, distributed valid eval, ...)."""
+    gbdt = booster._gbdt
+    if fobj is not None or feval is not None or valid_contain_train:
+        return False
+    if callbacks_before:
+        return False     # before-iteration callbacks (reset_parameter)
+    #                      mutate config mid-stream: inherently per-iter
+    if any(not getattr(cb, "batched_replay", False)
+           for cb in callbacks_after):
+        return False
+    if begin_iter >= num_boost_round:
+        return False
+    chunk = cfg.batched_chunk_size
+    interval = cfg.checkpoint_interval if ckpt_mgr is not None else 0
+    # host-mode window-constant sampling: cut chunks at resample points
+    # so no chunk ever straddles one (resampling at a chunk START is
+    # handled inside train_iters_batched, like the eager path)
+    strat = gbdt.sample_strategy
+    host_period = 0
+    if gbdt._batched_sampling_mode() == "host":
+        host_period = strat.resample_period()
+
+    def _boundary(it: int) -> int:
+        b = min(it + chunk, num_boost_round)
+        if interval > 0:
+            b = min(b, ((it // interval) + 1) * interval)
+        if host_period > 0:
+            b = min(b, ((it // host_period) + 1) * host_period)
+        return b
+
+    # gate on the FIRST cut chunk: later chunks are cut the same way, so
+    # its verdict holds for the whole run (can_batch_iters is O(1))
+    if not gbdt.can_batch_iters(_boundary(begin_iter) - begin_iter):
+        return False
+    layout = gbdt.batched_eval_layout() if booster.name_valid_sets else []
+    if layout is None:
+        return False     # a metric lacks a device analog
+
+    gbdt.start_drain()
+    stopped = False
+    chunks_done = 0
+    try:
+        it = begin_iter
+        while it < num_boost_round and not stopped:
+            boundary = _boundary(it)
+            n = boundary - it
+            mvals_dev = gbdt.train_iters_batched(n, n_pad=chunk)
+            chunks_done += 1
+            mvals = None
+            if mvals_dev is not None and callbacks_after:
+                import jax
+                mvals = np.asarray(jax.device_get(mvals_dev))
+            for j in range(it, boundary):
+                # the per-iteration loop saves AFTER update(j) and BEFORE
+                # callbacks(j); boundaries are interval-aligned, so the
+                # only save point in this chunk is its end — where
+                # gbdt.iter == j + 1 and the captured state matches the
+                # eager loop's bit for bit
+                if interval > 0 and (j + 1) % interval == 0:
+                    from .runtime.checkpoint import capture_trainer_state
+                    ckpt_mgr.save(
+                        capture_trainer_state(
+                            gbdt, best_iteration=booster.best_iteration),
+                        gbdt.iter)
+                evals = []
+                if mvals is not None:
+                    row = mvals[j - it]
+                    evals = [(name, mname, float(row[c]), hib)
+                             for c, (name, mname, hib)
+                             in enumerate(layout)]
+                try:
+                    for cb in callbacks_after:
+                        cb(CallbackEnv(
+                            model=booster, params=params, iteration=j,
+                            begin_iteration=begin_iter,
+                            end_iteration=num_boost_round,
+                            evaluation_result_list=evals))
+                except EarlyStopException as e:
+                    booster.best_iteration = e.best_iteration + 1
+                    for ds, metric, value, _ in e.best_score:
+                        booster.best_score.setdefault(
+                            ds, {})[metric] = value
+                    # retroactive stop: drop trees past the iteration
+                    # whose callback raised — exact, because iterations
+                    # j' > j never influenced metrics at <= j
+                    gbdt.truncate_to_iteration(j + 1)
+                    return True
+            it = boundary
+            # amortized no-more-splits check (one sync) at power-of-2
+            # chunk counts — mirrors update_batch; first chunk exempt
+            if it < num_boost_round and chunks_done > 1 \
+                    and (chunks_done & (chunks_done - 1)) == 0 \
+                    and gbdt._check_stopped():
+                gbdt._stopped = True
+                stopped = True
+    finally:
+        gbdt.stop_drain()
+    return True
 
 
 def warm_continue(params: Dict[str, Any], X, label,

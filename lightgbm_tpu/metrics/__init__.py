@@ -10,14 +10,38 @@ from __future__ import annotations
 
 from typing import Callable, List, Optional, Sequence, Tuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..config import Config
 from ..utils.log import log_fatal, log_warning
 
 _KEPS = 1e-15
+# device metrics run in f32: 1e-15 would round to 0 there and log(0)
+# follows — clip at the smallest eps that survives `1 - eps` in f32
+_KEPS_F32 = 1e-7
 
 MetricResult = Tuple[str, float, bool]  # (name, value, is_higher_better)
+
+
+def _device_convert_output(objective):
+    """jnp analog of `objective.convert_output` for in-scan metric eval
+    (docs/PERF.md §7). Returns identity when no transform is needed and
+    None when the objective's transform has no device analog — the
+    trainer then falls back to per-iteration host evaluation."""
+    if objective is None or not objective.need_convert_output:
+        return lambda s: s
+    name = getattr(objective, "name", "")
+    cfg = objective.config
+    if name == "binary" or name == "multiclassova":
+        sig = float(cfg.sigmoid)
+        return lambda s: 1.0 / (1.0 + jnp.exp(-sig * s))
+    if name == "multiclass":
+        return lambda s: jax.nn.softmax(s, axis=0)
+    if name in ("poisson", "gamma", "tweedie"):
+        return lambda s: jnp.exp(s)
+    return None
 
 
 class Metric:
@@ -40,6 +64,19 @@ class Metric:
     def eval(self, score: np.ndarray, objective) -> List[MetricResult]:
         raise NotImplementedError
 
+    def result_name(self) -> str:
+        """Name under which eval() reports its (single) result — only
+        multi_error@k differs from the class-level name."""
+        return self.name
+
+    def device_eval_fn(self, objective) -> Optional[Callable]:
+        """Traceable `fn(score, label, weight, sum_weights) -> f32 scalar`
+        evaluating this metric on device inside a scan body, or None when
+        no device analog exists (batched training then routes through the
+        per-iteration host loop). Device values are f32 — low-bit
+        divergence from the f64 host value is expected and documented."""
+        return None
+
     def _w(self) -> np.ndarray:
         if self.weight is not None:
             return self.weight.astype(np.float64)
@@ -50,12 +87,32 @@ class _PointwiseRegressionMetric(Metric):
     """reference: regression_metric.hpp RegressionMetric<T>."""
 
     transform_output = True
+    _device_point_loss = None  # staticmethod (cfg, y, s) -> loss, or None
 
     def point_loss(self, label: np.ndarray, score: np.ndarray) -> np.ndarray:
         raise NotImplementedError
 
     def final_transform(self, mean_loss: float) -> float:
         return mean_loss
+
+    def _device_final(self, v):
+        return v
+
+    def device_eval_fn(self, objective):
+        if self._device_point_loss is None:
+            return None
+        conv = _device_convert_output(objective) if self.transform_output \
+            else (lambda s: s)
+        if conv is None:
+            return None
+        point, final, cfg = self._device_point_loss, self._device_final, \
+            self.config
+
+        def fn(score, label, weight, sum_weights):
+            s = conv(jnp.reshape(score, (-1,)))
+            return final(jnp.sum(point(cfg, label, s) * weight)
+                         / sum_weights)
+        return fn
 
     def eval(self, score, objective) -> List[MetricResult]:
         score = np.asarray(score, np.float64).reshape(-1)
@@ -70,6 +127,7 @@ class _PointwiseRegressionMetric(Metric):
 
 class L2Metric(_PointwiseRegressionMetric):
     name = "l2"
+    _device_point_loss = staticmethod(lambda cfg, y, s: (s - y) ** 2)
 
     def point_loss(self, y, s):
         return (s - y) ** 2
@@ -81,9 +139,13 @@ class RMSEMetric(L2Metric):
     def final_transform(self, v):
         return float(np.sqrt(v))
 
+    def _device_final(self, v):
+        return jnp.sqrt(v)
+
 
 class L1Metric(_PointwiseRegressionMetric):
     name = "l1"
+    _device_point_loss = staticmethod(lambda cfg, y, s: jnp.abs(s - y))
 
     def point_loss(self, y, s):
         return np.abs(s - y)
@@ -91,6 +153,9 @@ class L1Metric(_PointwiseRegressionMetric):
 
 class QuantileMetric(_PointwiseRegressionMetric):
     name = "quantile"
+    _device_point_loss = staticmethod(
+        lambda cfg, y, s: jnp.where(
+            (y - s) >= 0, cfg.alpha * (y - s), (cfg.alpha - 1.0) * (y - s)))
 
     def point_loss(self, y, s):
         a = self.config.alpha
@@ -197,6 +262,22 @@ class BinaryLoglossMetric(Metric):
         w = self._w()
         return [(self.name, float(np.sum(loss * w) / self.sum_weights), False)]
 
+    def device_eval_fn(self, objective):
+        if objective is not None and objective.need_convert_output:
+            conv = _device_convert_output(objective)
+            if conv is None:
+                return None
+        else:
+            conv = lambda s: 1.0 / (1.0 + jnp.exp(-s))  # noqa: E731
+
+        def fn(score, label, weight, sum_weights):
+            p = conv(jnp.reshape(score, (-1,)))
+            y = (label > 0).astype(jnp.float32)
+            p = jnp.clip(p, _KEPS_F32, 1.0 - _KEPS_F32)
+            loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+            return jnp.sum(loss * weight) / sum_weights
+        return fn
+
 
 class BinaryErrorMetric(Metric):
     name = "binary_error"
@@ -210,6 +291,20 @@ class BinaryErrorMetric(Metric):
         w = self._w()
         err = (pred != y).astype(np.float64)
         return [(self.name, float(np.sum(err * w) / self.sum_weights), False)]
+
+    def device_eval_fn(self, objective):
+        if objective is not None and objective.need_convert_output:
+            conv = _device_convert_output(objective)
+            if conv is None:
+                return None
+        else:
+            conv = lambda s: s  # noqa: E731
+
+        def fn(score, label, weight, sum_weights):
+            p = conv(jnp.reshape(score, (-1,)))
+            err = ((p > 0.5) != (label > 0)).astype(jnp.float32)
+            return jnp.sum(err * weight) / sum_weights
+        return fn
 
 
 class AUCMetric(Metric):
@@ -235,6 +330,31 @@ class AUCMetric(Metric):
         cum_neg = np.cumsum(group_neg) - group_neg
         auc = np.sum(group_pos * (cum_neg + 0.5 * group_neg)) / (pos_w * neg_w)
         return [(self.name, float(auc), True)]
+
+    def device_eval_fn(self, objective):
+        # AUC is rank-based: no output transform needed (monotone convert
+        # preserves the ordering, as on the host path)
+        def fn(score, label, weight, sum_weights):
+            s = jnp.reshape(score, (-1,))
+            n = s.shape[0]
+            order = jnp.argsort(s)  # stable ascending, mirrors mergesort
+            s_s, y_s, w_s = s[order], (label > 0)[order], weight[order]
+            yw = w_s * y_s.astype(jnp.float32)
+            nw = w_s * (~y_s).astype(jnp.float32)
+            pos_w, neg_w = jnp.sum(yw), jnp.sum(nw)
+            # tie groups: consecutive equal scores share a group id
+            gid = jnp.concatenate([
+                jnp.zeros((1,), jnp.int32),
+                jnp.cumsum((s_s[1:] != s_s[:-1]).astype(jnp.int32))])
+            group_pos = jax.ops.segment_sum(yw, gid, num_segments=n)
+            group_neg = jax.ops.segment_sum(nw, gid, num_segments=n)
+            cum_neg = jnp.cumsum(group_neg) - group_neg
+            auc = jnp.sum(group_pos * (cum_neg + 0.5 * group_neg)) \
+                / jnp.maximum(pos_w * neg_w, _KEPS_F32)
+            # degenerate single-class valid set reports 1.0 like the host
+            return jnp.where((pos_w <= 0) | (neg_w <= 0),
+                             jnp.float32(1.0), auc)
+        return fn
 
 
 class AveragePrecisionMetric(Metric):
@@ -276,6 +396,19 @@ class MultiLoglossMetric(Metric):
         loss = float(np.sum(-np.log(pi) * w) / self.sum_weights)
         return [(self.name, loss, False)]
 
+    def device_eval_fn(self, objective):
+        conv = _device_convert_output(objective)
+        if conv is None:
+            return None
+
+        def fn(score, label, weight, sum_weights):
+            p = conv(score)  # [K, N]
+            li = label.astype(jnp.int32)
+            pi = p[li, jnp.arange(p.shape[1])]
+            pi = jnp.clip(pi, _KEPS_F32, 1.0)
+            return jnp.sum(-jnp.log(pi) * weight) / sum_weights
+        return fn
+
 
 class MultiErrorMetric(Metric):
     name = "multi_error"
@@ -295,6 +428,24 @@ class MultiErrorMetric(Metric):
             err = (~hit).astype(np.float64)
         name = self.name if k <= 1 else f"multi_error@{k}"
         return [(name, float(np.sum(err * w) / self.sum_weights), False)]
+
+    def result_name(self) -> str:
+        k = self.config.multi_error_top_k
+        return self.name if k <= 1 else f"multi_error@{k}"
+
+    def device_eval_fn(self, objective):
+        # argmax/top-k membership is transform-invariant, raw scores ok
+        k = self.config.multi_error_top_k
+
+        def fn(score, label, weight, sum_weights):
+            li = label.astype(jnp.int32)
+            if k <= 1:
+                err = (jnp.argmax(score, axis=0) != li)
+            else:
+                _, topi = jax.lax.top_k(score.T, k)  # [N, k]
+                err = ~jnp.any(topi == li[:, None], axis=1)
+            return jnp.sum(err.astype(jnp.float32) * weight) / sum_weights
+        return fn
 
 
 # ---------------------------------------------------------------------------

@@ -16,8 +16,12 @@ caller needs host trees earlier (save/predict/DART/RF paths).
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import functools
+import os
+import queue
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -173,6 +177,16 @@ class GBDT:
         self._valid_scores: List[jnp.ndarray] = []
         self._valid_meta: List[FeatureMeta] = []
         self._valid_Xt: List[jnp.ndarray] = []
+        # batched training (docs/PERF.md §7): per-valid-set metric objects
+        # + device label/weight for in-scan eval, the bounded scan-fn
+        # cache, the async tree-drain worker, and the jitted-dispatch
+        # counter (bench_batched.py's dispatches-per-iteration number)
+        self._valid_metrics: List[List[Metric]] = []
+        self._valid_label_dev: List[Optional[jnp.ndarray]] = []
+        self._valid_weight_dev: List[jnp.ndarray] = []
+        self._valid_sumw: List[float] = []
+        self._drain = None
+        self.dispatch_count = 0
         self.best_iteration = -1
         self.loaded_parameter = ""
         self.max_feature_idx_ = 0
@@ -923,6 +937,22 @@ class GBDT:
         self.valid_names.append(name)
         for m in metrics:
             m.init(ds.metadata, ds.num_data)
+        # in-scan eval state (docs/PERF.md §7): the batched path computes
+        # these metrics on device inside the boosting scan, so it needs
+        # device-resident label/weight and the metric objects themselves
+        self._valid_metrics.append(list(metrics))
+        md = ds.metadata
+        self._valid_label_dev.append(
+            jnp.asarray(np.asarray(md.label, np.float32))
+            if md.label is not None else None)
+        if md.weight is not None:
+            w = np.asarray(md.weight, np.float32)
+            self._valid_weight_dev.append(jnp.asarray(w))
+            self._valid_sumw.append(float(np.sum(w)))
+        else:
+            self._valid_weight_dev.append(
+                jnp.ones((ds.num_data,), jnp.float32))
+            self._valid_sumw.append(float(ds.num_data))
 
     # ------------------------------------------------------------------
     @property
@@ -932,6 +962,8 @@ class GBDT:
         return self._models
 
     def _materialize_models(self) -> None:
+        if self._drain is not None:
+            self._drain.flush()
         if not self._pending:
             return
         pending, self._pending = self._pending, []
@@ -941,24 +973,39 @@ class GBDT:
         with global_timer.section("GBDT::MaterializeModels"):
             hosts = jax.device_get([t for t, _ in pending])
             for host, (_, bias) in zip(hosts, pending):
-                if isinstance(bias, list):
-                    flat = [jax.tree.map(
-                        lambda a, i=i, k=k: a[i, k], host)
-                        for i in range(host.num_leaves.shape[0])
-                        for k in range(host.num_leaves.shape[1])]
-                else:
-                    flat = [host]
-                    bias = [bias]
-                for h, b in zip(flat, bias):
-                    tree = self._device_tree_to_host(h)
-                    if abs(b) > _KEPS:
-                        tree.add_bias(b)
-                    self._models.append(tree)
+                self._models.extend(self._host_record_to_trees(host, bias))
+
+    def _host_record_to_trees(self, host, bias) -> List[Tree]:
+        """Convert one device_get'd pending record (single tree or a
+        stacked [n, K, ...] chunk) into host Trees. The bias list length
+        is authoritative for chunk records: padded tail-chunk rows (when
+        the scan ran n_pad > n iterations) carry no bias entry and are
+        never materialized."""
+        K = self.num_tree_per_iteration
+        if isinstance(bias, list):
+            flat = [jax.tree.map(
+                lambda a, i=i, k=k: a[i, k], host)
+                for i in range(len(bias) // K)
+                for k in range(K)]
+        else:
+            flat = [host]
+            bias = [bias]
+        out = []
+        for h, b in zip(flat, bias):
+            tree = self._device_tree_to_host(h)
+            if abs(b) > _KEPS:
+                tree.add_bias(b)
+            out.append(tree)
+        return out
 
     def _check_stopped(self) -> bool:
         """Fetch the pending trees' leaf counts (one sync) and report
         whether the last iteration produced only stumps (reference stop
         condition, gbdt.cpp:376-384)."""
+        if self._drain is not None:
+            # drained chunks land in _models; flush so the _models[-K:]
+            # branch below sees the latest iteration
+            self._drain.flush()
         K = self.num_tree_per_iteration
         if self._pending:
             # gather the last K tree leaf-counts in ONE batched transfer
@@ -1009,15 +1056,74 @@ class GBDT:
         return self._grad_fn(self.scores, self.label_dev, self.weight_dev)
 
     # ------------------------------------------------------------------
+    # batched training: host-free boosting chunks (docs/PERF.md §7)
+    # ------------------------------------------------------------------
+    _SCAN_CACHE_MAX = 4   # bounded LRU over (chunk, metric, mode) keys
+
+    def _count_dispatch(self, n: int = 1) -> None:
+        """Count jitted host->device dispatches — the number
+        bench_batched.py divides by iterations; mirrored into the
+        profiler counters when device_profile is on."""
+        self.dispatch_count += n
+        if self.profiler is not None:
+            self.profiler.add_counter("dispatches", n)
+
+    def _batched_sampling_mode(self) -> str:
+        """'scan' = the in-bag mask is drawn inside the scan body as a
+        pure function of the iteration (device-side bagging/GOSS);
+        'host' = a window-constant mask is passed in, as before."""
+        strat = self.sample_strategy
+        if strat.supports_scan and not self.use_dist \
+                and (strat.resample_period() > 0 or strat.needs_grad):
+            return "scan"
+        return "host"
+
+    def _device_metric_layout(self):
+        """[(vi, metric, device_fn)] covering EVERY valid-set metric, or
+        None when any metric lacks a device analog (the batched path then
+        defers to per-iteration host eval). Order defines the metric
+        column layout of train_iters_batched's stacked values."""
+        out = []
+        for vi, metrics in enumerate(self._valid_metrics):
+            for m in metrics:
+                fn = m.device_eval_fn(self.objective)
+                if fn is None:
+                    return None
+                out.append((vi, m, fn))
+        return out
+
+    def batched_eval_layout(self):
+        """(valid_name, metric_result_name, higher_better) per metric
+        column of the in-scan metric stack — the engine reconstructs
+        per-iteration evaluation_result_lists from this. None when some
+        metric has no device analog."""
+        lay = self._device_metric_layout()
+        if lay is None:
+            return None
+        return [(self.valid_names[vi], m.result_name(), m.is_higher_better)
+                for vi, m, _ in lay]
+
     def can_batch_iters(self, n: int) -> bool:
         """Whether `n` whole-chunk device iterations (train_iters_batched)
-        are semantically equivalent to repeated train_one_iter calls:
-        plain GBDT, device-side objective, no re-sampling inside the
-        window."""
+        are semantically equivalent to repeated train_one_iter calls.
+        Batched is the DEFAULT for realistic configs: device-side
+        bagging/GOSS and in-scan valid eval run inside the scan, so
+        resampling and valid sets no longer force the per-iteration
+        path. O(1) — the cached per-strategy resample period replaces
+        the old per-iteration resamples_at probe loop."""
         if type(self) is not GBDT:
             return False          # DART/RF override per-iter behavior
-        if self.profiler is not None:
-            return False          # per-iteration spans need the host fence
+        if not self.config.batched_train or os.environ.get(
+                "LIGHTGBM_TPU_DISABLE_BATCHED", "") not in ("", "0"):
+            return False          # escape hatches (config knob + env)
+        if self.num_tree_per_iteration != 1:
+            # multiclass (K > 1) stays per-iteration: compiling K tree
+            # grows into one program lets XLA partition the histogram
+            # reductions differently than the standalone-jitted grow,
+            # and the reassociated f32 sums break the md5 parity
+            # guarantee by ULPs (observed on CPU; program-shape
+            # sensitive, not controllable from JAX)
+            return False
         if self._linear:
             return False          # per-tree host ridge fits
         if self.objective is None or self.objective.runs_on_host:
@@ -1027,93 +1133,257 @@ class GBDT:
         if self._cegb_on:
             return False          # coupled-penalty state is carried across
         #                           iterations outside the scan
+        if self._fault_plan is not None:
+            return False          # kill@iter / collective faults fire in
+        #                           train_one_iter's watchdog only
+        strat = self.sample_strategy
+        if self._batched_sampling_mode() == "host":
+            if strat.needs_grad:
+                return False      # gradient-aware masks can't be pre-drawn
+            # window-constant masks only: a resample strictly inside
+            # (iter, iter+n) would need a host boundary. The window
+            # (iter+1 .. iter+n-1) contains a multiple of the period p
+            # iff the floor-quotient advances.
+            p = strat.resample_period()
+            if p > 0 and (self.iter + n - 1) // p > self.iter // p:
+                return False
         if self.valid_sets:
-            return False          # valid-score replay is per-iteration
-        if any(self.sample_strategy.resamples_at(self.iter + i)
-               for i in range(1, n)):
-            return False
+            if self.use_dist or self._pre_part:
+                return False      # valid replay/averaging is host-side
+            if self._device_metric_layout() is None:
+                return False      # a metric lacks a device analog
         return True
 
-    def train_iters_batched(self, n: int) -> None:
+    def train_iters_batched(self, n: int, n_pad: Optional[int] = None
+                            ) -> Optional[jnp.ndarray]:
         """Run `n` boosting iterations as ONE jitted lax.scan — no host
         round-trips at all (the reference's TrainOneIter loop,
         gbdt.cpp:246-265, with the per-iteration host boundary removed).
-        Caller must have checked can_batch_iters()."""
+        Caller must have checked can_batch_iters().
+
+        When ``n_pad > n`` the scan still runs n_pad steps — every chunk
+        reuses ONE compiled fn regardless of tail size — with the
+        surplus steps inert (score updates masked out, trees sliced off
+        on device). Scan-capable sample strategies draw their in-bag
+        mask INSIDE the body from iteration-keyed jax.random streams,
+        bit-identical to the eager mask for the same iteration; valid
+        scores and metrics update in-scan too. Returns the stacked
+        per-iteration metric values as a [n, M] device array (columns =
+        batched_eval_layout()), or None when no valid metrics ride
+        along."""
+        n_pad = max(n, int(n_pad or n))
         K = self.num_tree_per_iteration
+        prof = self.profiler
+        t0 = None
+        if prof is not None:
+            from ..runtime.profiler import device_barrier
+            device_barrier()
+            t0 = time.perf_counter()
         init_scores = np.zeros(K)
         if self.iter == 0:
             init_scores = self._boost_from_average()
-        if self._in_bag_dev is None \
-                or self.sample_strategy.resamples_at(self.iter):
-            in_bag = self.sample_strategy.sample(self.iter, None, None)
-            if self._host_pad != self.num_data:
-                in_bag = jnp.pad(in_bag,
-                                 (0, self._host_pad - self.num_data))
-            self._in_bag_dev = self._put_rows(in_bag, row_axis=0)
+        mode = self._batched_sampling_mode()
+        if mode == "host":
+            if self._in_bag_dev is None \
+                    or self.sample_strategy.resamples_at(self.iter):
+                in_bag = self.sample_strategy.sample(self.iter, None, None)
+                if self._host_pad != self.num_data:
+                    in_bag = jnp.pad(in_bag,
+                                     (0, self._host_pad - self.num_data))
+                self._in_bag_dev = self._put_rows(in_bag, row_axis=0)
+            in_bag0 = self._in_bag_dev
+        else:
+            # drawn in-scan; a constant placeholder keeps the compiled
+            # fn's arg pytree identical across chunks
+            in_bag0 = getattr(self, "_in_bag_ones", None)
+            if in_bag0 is None or in_bag0.shape[0] != self._host_pad:
+                in_bag0 = self._in_bag_ones = jnp.ones(
+                    (self._host_pad,), jnp.float32)
 
         # per-iteration feature masks, precomputed host-side (same RNG
-        # stream as the per-iteration path)
+        # stream as the per-iteration path); padded steps reuse an
+        # all-ones mask (their trees are discarded)
         F = len(self.mappers)
-        masks_dev = jnp.stack([
-            m if m is not None else jnp.ones((F,), bool)
-            for m in (self._feature_mask_for_iter(self.iter + i)
-                      for i in range(n))])
+        masks_dev = jnp.stack(
+            [m if m is not None else jnp.ones((F,), bool)
+             for m in (self._feature_mask_for_iter(self.iter + i)
+                       for i in range(n))]
+            + [jnp.ones((F,), bool)] * (n_pad - n))
 
-        base_seed = self.config.seed or 0
-        seeds_dev = jnp.arange(self.iter, self.iter + n,
-                               dtype=jnp.int32) + base_seed
-        scan_fn = self._get_scan_fn(n)
+        scan_fn = self._get_scan_fn(n_pad, mode)
+        self._count_dispatch()
         with global_timer.section("GBDT::TrainItersBatched/scan"):
-            new_scores, tree_stack = scan_fn(
-            self.X_t, self.scores, self.label_dev, self.weight_dev,
-            self._in_bag_dev, jnp.float32(self.shrinkage_rate), masks_dev,
-            seeds_dev)
+            new_scores, new_vscores, tree_stack, mvals = scan_fn(
+                self.X_t, self.scores, self.label_dev, self.weight_dev,
+                in_bag0, jnp.float32(self.shrinkage_rate),
+                jnp.int32(self.iter), jnp.int32(n), masks_dev,
+                tuple(self._valid_Xt),
+                tuple(tuple(m) for m in self._valid_meta),
+                tuple(self._valid_scores),
+                tuple(self._valid_label_dev),
+                tuple(self._valid_weight_dev),
+                tuple(jnp.float32(s) for s in self._valid_sumw))
         self.scores = new_scores
+        for vi, vs in enumerate(new_vscores):
+            self._valid_scores[vi] = vs
+        if n < n_pad:
+            # tail chunk: drop the inert steps' trees/metrics on device so
+            # pending stacks and stop checks never see padding rows
+            tree_stack = jax.tree.map(lambda a: a[:n], tree_stack)
+            mvals = mvals[:n]
+            self._count_dispatch()
         # ONE stacked pending record for the whole chunk (slicing happens
         # host-side at materialization — per-tree device slices would
         # reintroduce hundreds of dispatches); iteration-0 bias folds into
-        # the first tree
+        # the first tree. With the async drain active, the record goes to
+        # the worker so host conversion overlaps the NEXT chunk's device
+        # compute.
         biases = [
             float(init_scores[k]) if (self.iter + i) == 0 else 0.0
             for i in range(n) for k in range(K)]
-        self._pending.append((tree_stack, biases))
+        record = (tree_stack, biases)
+        if self._drain is not None:
+            self._drain.submit(record)
+        else:
+            self._pending.append(record)
         self.iter += n
+        if prof is not None:
+            from ..runtime.profiler import device_barrier
+            device_barrier()   # fence: the span covers this chunk only
+            prof.record_batched_chunk(n, time.perf_counter() - t0,
+                                      n_rows=self.num_data * n)
+        return mvals if int(mvals.shape[-1]) > 0 else None
 
-    def _get_scan_fn(self, n: int):
-        key = (n, self.num_tree_per_iteration)
+    def _get_scan_fn(self, n_pad: int, mode: str):
+        """Compiled whole-chunk scan, cached on the PADDED chunk size (so
+        varying tail sizes don't retrace), the sampling mode, and the
+        valid/metric signature. The cache is a bounded LRU: unbounded
+        growth across chunk-size changes would pin stale executables."""
+        K = self.num_tree_per_iteration
+        metric_layout = self._device_metric_layout() or []
+        metric_sig = tuple((vi, type(m).__name__, m.result_name())
+                           for vi, m, _ in metric_layout)
+        key = (n_pad, K, mode, len(self.valid_sets), metric_sig)
         cache = getattr(self, "_scan_fns", None)
         if cache is None:
-            cache = self._scan_fns = {}
+            cache = self._scan_fns = collections.OrderedDict()
         if key in cache:
+            cache.move_to_end(key)
             return cache[key]
-        K = self.num_tree_per_iteration
         obj = self.objective
         train_tree = self._train_tree
+        valid_upd = self._valid_update
+        strat = self.sample_strategy
+        n_valid = len(self.valid_sets)
+        metric_fns = [(vi, fn) for vi, _, fn in metric_layout]
+        base_seed = self.config.seed or 0
+        host_pad, num_data = self._host_pad, self.num_data
 
         @jax.jit
-        def scan_fn(X_t, scores0, label, weight, in_bag, lr, masks, seeds):
-            def step(scores, xs):
-                mask, seed = xs
+        def scan_fn(X_t, scores0, label, weight, in_bag0, lr, start_iter,
+                    n_active, masks, vXts, vmetas, vscores0, vlabels,
+                    vweights, vsumw):
+            def step(carry, xs):
+                scores, vscores = carry
+                mask, i = xs
+                it = start_iter + i
+                active = i < n_active
                 if K == 1:
                     g, h = obj.get_gradients(scores[0], label, weight)
                     g, h = g[None, :], h[None, :]
                 else:
                     g, h = obj.get_gradients(scores, label, weight)
+                if mode == "scan":
+                    # device-side bagging/GOSS: pure function of `it`
+                    # (+ this step's gradients for GOSS), bit-identical
+                    # to the eager sample() for the same iteration
+                    bag = strat.mask_for_iter(it, g, h)
+                    if host_pad != num_data:
+                        bag = jnp.pad(bag, (0, host_pad - num_data))
+                else:
+                    bag = in_bag0
+                new_scores = scores
+                new_vscores = list(vscores)
                 trees = []
                 for k in range(K):
+                    seed = (it + base_seed) * K + k
                     tree, _, ns = train_tree(
                         X_t, g[k], h[k],
-                        in_bag if in_bag.ndim == 1 else in_bag[k],
-                        scores[k], lr, mask, seed * K + k)
-                    scores = scores.at[k].set(ns)
+                        bag if bag.ndim == 1 else bag[k],
+                        new_scores[k], lr, mask, seed)
+                    new_scores = new_scores.at[k].set(ns)
                     trees.append(tree)
+                    for vi in range(n_valid):
+                        new_vscores[vi] = new_vscores[vi].at[k].set(
+                            valid_upd(
+                                tree.split_feature, tree.threshold_bin,
+                                tree.default_left, tree.left_child,
+                                tree.right_child, tree.num_leaves,
+                                tree.leaf_value, vXts[vi], vmetas[vi],
+                                new_vscores[vi][k], lr,
+                                tree.split_is_cat, tree.split_cat_bitset))
+                # padded tail steps are inert: carried state keeps its
+                # value; their (garbage) trees are sliced off on device
+                new_scores = jnp.where(active, new_scores, scores)
+                new_vscores = tuple(
+                    jnp.where(active, nv, ov)
+                    for nv, ov in zip(new_vscores, vscores))
+                if metric_fns:
+                    mvals = jnp.stack([
+                        fn(new_vscores[vi], vlabels[vi], vweights[vi],
+                           vsumw[vi])
+                        for vi, fn in metric_fns])
+                else:
+                    mvals = jnp.zeros((0,), jnp.float32)
                 stacked = jax.tree.map(lambda *a: jnp.stack(a), *trees)
-                return scores, stacked
+                return (new_scores, new_vscores), (stacked, mvals)
 
-            return jax.lax.scan(step, scores0, (masks, seeds))
+            (scores, vscores), (tree_stack, mvals) = jax.lax.scan(
+                step, (scores0, tuple(vscores0)),
+                (masks, jnp.arange(n_pad, dtype=jnp.int32)))
+            return scores, vscores, tree_stack, mvals
 
         cache[key] = scan_fn
+        while len(cache) > self._SCAN_CACHE_MAX:
+            cache.popitem(last=False)
         return scan_fn
+
+    def start_drain(self) -> None:
+        """Attach an async tree drain: chunk records produced by
+        train_iters_batched are device_get'd and converted to host Trees
+        on a worker thread, overlapping host materialization with the
+        next chunk's device compute (double-buffering). Idempotent."""
+        if self._drain is not None:
+            return
+        # fold any per-iteration leftovers in first so _models stays
+        # ordered once drained chunks start appending
+        self._materialize_models()
+        self._drain = _AsyncTreeDrain(self)
+
+    def stop_drain(self) -> None:
+        """Detach and join the drain worker, folding everything it
+        converted into _models. Safe to call repeatedly / without
+        start_drain."""
+        drain, self._drain = self._drain, None
+        if drain is not None:
+            drain.close()
+
+    def truncate_to_iteration(self, n_iters: int) -> None:
+        """Drop trees beyond the first `n_iters` iterations — the
+        retroactive arm of batched early stopping. Exact because later
+        trees never affect earlier iterations' metrics: cutting the model
+        back to the stop point yields byte-identical trees to having
+        stopped live. `self.scores`/valid scores intentionally keep the
+        surplus contributions (training is over; predictions use the
+        materialized model, and warm-continue from a truncated model goes
+        through model I/O which rebuilds scores)."""
+        self._materialize_models()
+        keep = n_iters * self.num_tree_per_iteration
+        if keep < len(self._models):
+            del self._models[keep:]
+        self.iter = min(self.iter, n_iters)
+        self._packed_cache = None
+        self._device_tables_cache = None
 
     def train_one_iter(self, grad: Optional[np.ndarray] = None,
                        hess: Optional[np.ndarray] = None) -> bool:
@@ -1145,6 +1415,7 @@ class GBDT:
                     hess = np.pad(hess, pad)
                 g_dev = self._put_rows(jnp.asarray(grad), row_axis=1)
                 h_dev = self._put_rows(jnp.asarray(hess), row_axis=1)
+        self._count_dispatch()   # gradient computation
 
         strat = self.sample_strategy
         if self._in_bag_dev is None or strat.resamples_at(self.iter):
@@ -1176,6 +1447,7 @@ class GBDT:
                     in_bag if in_bag.ndim == 1 else in_bag[k],
                     self.scores[k], lr, feat_mask,
                     jnp.int32((base_seed + self.iter) * K + k))
+            self._count_dispatch()   # tree-grow dispatch
             if (self.objective is not None
                     and self.objective.need_renew_tree_output):
                 tree_dev, new_scores = self._renew_tree_output(
@@ -1209,6 +1481,7 @@ class GBDT:
                                 self._valid_scores[vi][k], lr,
                                 tree_dev.split_is_cat,
                                 tree_dev.split_cat_bitset))
+                self._count_dispatch(len(self.valid_sets))
             # boost-from-average bias is folded into the first tree at
             # materialization time (gbdt.cpp:425-427)
             bias = init_scores[k] if self.iter == 0 else 0.0
@@ -1927,6 +2200,63 @@ class GBDT:
             gbdt.models.append(Tree.from_string(body))
         gbdt.iter = len(gbdt.models) // max(gbdt.num_tree_per_iteration, 1)
         return gbdt
+
+
+class _AsyncTreeDrain:
+    """Background materializer for batched-training chunk records.
+
+    train_iters_batched submits one stacked record per chunk; the worker
+    thread device_get's it and converts it to host Trees while the main
+    thread dispatches the NEXT chunk — double-buffering host
+    materialization against device compute. Converted trees are folded
+    into ``gbdt._models`` only on flush() (main thread), so the model
+    list is never mutated concurrently. While a drain is attached,
+    nothing else appends to ``gbdt._pending``."""
+
+    def __init__(self, gbdt: "GBDT"):
+        self._gbdt = gbdt
+        self._q: "queue.Queue" = queue.Queue()
+        self._done: List[List[Tree]] = []
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._run, name="gbdt-tree-drain", daemon=True)
+        self._thread.start()
+
+    def submit(self, record) -> None:
+        self._q.put(record)
+
+    def _run(self) -> None:
+        while True:
+            rec = self._q.get()
+            try:
+                if rec is None:
+                    return
+                if self._error is not None:
+                    continue   # fail fast: skip work after first error
+                host = jax.device_get(rec[0])
+                self._done.append(
+                    self._gbdt._host_record_to_trees(host, rec[1]))
+            except BaseException as e:   # surfaced on flush()
+                self._error = e
+            finally:
+                self._q.task_done()
+
+    def flush(self) -> None:
+        """Block until the queue drains, then fold converted trees into
+        the owning GBDT's _models (in submission order). Re-raises any
+        worker-side error on the caller's thread."""
+        self._q.join()
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+        done, self._done = self._done, []
+        for trees in done:
+            self._gbdt._models.extend(trees)
+
+    def close(self) -> None:
+        self.flush()
+        self._q.put(None)
+        self._thread.join(timeout=10.0)
 
 
 def _config_from_objective_string(obj_str: str, base: Config) -> Config:

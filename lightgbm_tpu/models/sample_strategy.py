@@ -10,6 +10,17 @@ dense [N] multiplier vector: 0 for out-of-bag rows, 1 for in-bag, and
 grad/hess by it; histogram COUNTS use only the 0/1 in-bag indicator
 (GOSS amplification rides on the gradients alone in the reference,
 goss.hpp — counts stay true row counts), all with static shapes.
+
+Scan contract (docs/PERF.md §7): strategies with `supports_scan=True`
+expose `mask_for_iter(it, grad, hess)` as a pure, traceable function of
+the iteration number — `it` may be a traced int32 inside `lax.scan`.
+The mask for iteration `it` depends only on (seed, floor(it / period))
+[plus grad/hess for GOSS], so the eager per-iteration path, the in-scan
+batched path, and checkpoint-restore re-derivation all reconstruct
+bit-identical masks from the iteration number alone. Strategies whose
+sampling is inherently host-side (class-stratified and by-query bagging
+use exact-count numpy draws over irregular groups) keep
+`supports_scan=False` and route training through the per-iteration loop.
 """
 
 from __future__ import annotations
@@ -27,31 +38,52 @@ from ..utils.log import log_info, log_warning
 class SampleStrategy:
     is_hessian_change = False
     needs_grad = False       # True when sample() actually reads grad/hess
+    supports_scan = True     # mask_for_iter is pure/traceable in `it`
 
     def __init__(self, config: Config, num_data: int, metadata):
         self.config = config
         self.num_data = num_data
         self.metadata = metadata
 
+    def resample_period(self) -> int:
+        """0 = the mask never changes after iteration 0; p > 0 = a fresh
+        mask every p iterations. O(1) replacement for probing
+        `resamples_at` across a whole chunk."""
+        return 0
+
     def resamples_at(self, it: int) -> bool:
         """Whether sample() would produce a new mask at iteration `it`
         (lets the trainer cache the padded/sharded mask otherwise)."""
-        return False
+        p = self.resample_period()
+        return p > 0 and it % p == 0
 
-    def sample(self, it: int, grad: jnp.ndarray, hess: jnp.ndarray
-               ) -> jnp.ndarray:
-        """Returns the [N] in-bag multiplier for iteration `it`."""
+    def mask_for_iter(self, it, grad=None, hess=None) -> jnp.ndarray:
+        """[num_data] multiplier as a pure function of `it` (int or traced
+        int32). grad/hess are only read when `needs_grad` is set."""
         return jnp.ones((self.num_data,), jnp.float32)
+
+    def sample(self, it: int, grad, hess) -> jnp.ndarray:
+        """Returns the [N] in-bag multiplier for iteration `it`."""
+        return self.mask_for_iter(it, grad, hess)
 
 
 class BaggingSampleStrategy(SampleStrategy):
     """reference: bagging.hpp:15. Re-samples every `bagging_freq` iterations
     with fraction `bagging_fraction` (optionally class-stratified via
-    pos/neg_bagging_fraction)."""
+    pos/neg_bagging_fraction).
+
+    Uniform row bagging draws on device: a threefry uniform keyed by
+    fold_in(PRNGKey(bagging_seed), floor(it/freq)*freq) with an exact-count
+    top_k threshold, so the mask traces inside lax.scan and replays
+    bit-identically from the iteration number (checkpoint restore,
+    batched-vs-eager parity). Stratified and by-query variants keep the
+    numpy exact-count draws (irregular group shapes) and opt out of the
+    scan path."""
 
     def __init__(self, config: Config, num_data: int, metadata):
         super().__init__(config, num_data, metadata)
         self._cached: Optional[jnp.ndarray] = None
+        self._cached_at: int = -1
         self._balanced = (config.pos_bagging_fraction < 1.0
                           or config.neg_bagging_fraction < 1.0)
         if self._balanced and metadata.label is None:
@@ -68,18 +100,43 @@ class BaggingSampleStrategy(SampleStrategy):
             log_warning("bagging_by_query ignores pos/neg bagging "
                         "fractions (query-level sampling)")
             self._balanced = False
+        self.supports_scan = not (self._balanced or self._by_query)
+        self._cnt = max(1, int(num_data * config.bagging_fraction))
+        self._key = jax.random.PRNGKey(config.bagging_seed)
 
-    def _need_resample(self, it: int) -> bool:
-        freq = max(self.config.bagging_freq, 1)
-        return self._cached is None or it % freq == 0
+    def resample_period(self) -> int:
+        return max(self.config.bagging_freq, 1)
 
-    def resamples_at(self, it: int) -> bool:
-        return self._need_resample(it)
+    def _floor_iter(self, it):
+        freq = self.resample_period()
+        return (it // freq) * freq
+
+    def mask_for_iter(self, it, grad=None, hess=None):
+        # keyed by the FLOORED iteration: iterations inside one bagging
+        # window share a key, so the mask is a pure function of `it` with
+        # no carried cache — scan bodies and checkpoint restore both
+        # reconstruct it exactly
+        key = jax.random.fold_in(self._key, self._floor_iter(it))
+        u = jax.random.uniform(key, (self.num_data,))
+        # exact-count draw: keep the `cnt` smallest uniforms (threefry
+        # draws are distinct w.p. 1, so the count is exact like
+        # rng.choice(N, cnt, replace=False))
+        kth = -jax.lax.top_k(-u, self._cnt)[0][-1]
+        return (u <= kth).astype(jnp.float32)
 
     def sample(self, it, grad, hess):
-        if not self._need_resample(it):
+        it_r = int(self._floor_iter(it))
+        if self._cached is not None and self._cached_at == it_r:
             return self._cached
-        rng = np.random.RandomState(self.config.bagging_seed + it)
+        if self._by_query or self._balanced:
+            mask = self._host_sample(it_r)
+        else:
+            mask = self.mask_for_iter(it)
+        self._cached, self._cached_at = mask, it_r
+        return mask
+
+    def _host_sample(self, it_r: int) -> jnp.ndarray:
+        rng = np.random.RandomState(self.config.bagging_seed + it_r)
         N = self.num_data
         mask = np.zeros(N, dtype=np.float32)
         if self._by_query:
@@ -91,21 +148,15 @@ class BaggingSampleStrategy(SampleStrategy):
             keep_flags = np.zeros(nq, np.float32)
             keep_flags[keep] = 1.0
             mask = np.repeat(keep_flags, np.diff(qb))
-            self._cached = jnp.asarray(mask)
-            return self._cached
-        if self._balanced:
-            label = self.metadata.label
-            pos = np.flatnonzero(label > 0)
-            neg = np.flatnonzero(label <= 0)
-            np_pos = int(len(pos) * self.config.pos_bagging_fraction)
-            np_neg = int(len(neg) * self.config.neg_bagging_fraction)
-            mask[rng.choice(pos, np_pos, replace=False)] = 1.0
-            mask[rng.choice(neg, np_neg, replace=False)] = 1.0
-        else:
-            cnt = int(N * self.config.bagging_fraction)
-            mask[rng.choice(N, cnt, replace=False)] = 1.0
-        self._cached = jnp.asarray(mask)
-        return self._cached
+            return jnp.asarray(mask)
+        label = self.metadata.label
+        pos = np.flatnonzero(label > 0)
+        neg = np.flatnonzero(label <= 0)
+        np_pos = int(len(pos) * self.config.pos_bagging_fraction)
+        np_neg = int(len(neg) * self.config.neg_bagging_fraction)
+        mask[rng.choice(pos, np_pos, replace=False)] = 1.0
+        mask[rng.choice(neg, np_neg, replace=False)] = 1.0
+        return jnp.asarray(mask)
 
 
 class GOSSStrategy(SampleStrategy):
@@ -125,18 +176,20 @@ class GOSSStrategy(SampleStrategy):
         seed = config.data_random_seed
         self._key = jax.random.PRNGKey(seed)
 
-    def resamples_at(self, it: int) -> bool:
-        return True
+    def resample_period(self) -> int:
+        return 1
 
-    def sample(self, it, grad, hess):
-        if it < self.warmup_iters:
-            return jnp.ones((self.num_data,), jnp.float32)
-        # sum |g*h| over classes (goss.hpp Bagging: sums over tree_id)
-        if grad.ndim == 2:
-            g_abs = jnp.sum(jnp.abs(grad * hess), axis=0)
-        else:
-            g_abs = jnp.abs(grad * hess)
+    def mask_for_iter(self, it, grad=None, hess=None):
         N = self.num_data
+        # grads may arrive padded to the device row count (scan body);
+        # padded tail rows carry junk |g*h| and must not win top_k slots
+        g = grad[..., :N]
+        h = hess[..., :N]
+        # sum |g*h| over classes (goss.hpp Bagging: sums over tree_id)
+        if g.ndim == 2:
+            g_abs = jnp.sum(jnp.abs(g * h), axis=0)
+        else:
+            g_abs = jnp.abs(g * h)
         # threshold at the top_k-th largest magnitude
         topv, _ = jax.lax.top_k(g_abs, self.top_k)
         threshold = topv[-1]
@@ -149,8 +202,18 @@ class GOSSStrategy(SampleStrategy):
         p_accept = self.other_k / max(N - self.top_k, 1)
         sampled_rest = rest & (u < p_accept)
         multiplier = (1.0 - self.config.top_rate) / self.config.other_rate
-        return (is_top.astype(jnp.float32)
+        mask = (is_top.astype(jnp.float32)
                 + sampled_rest.astype(jnp.float32) * multiplier)
+        # reference warm-up: all data for the first 1/learning_rate
+        # iterations — jnp.where (not Python if) so `it` may be traced
+        return jnp.where(jnp.asarray(it) < self.warmup_iters,
+                         jnp.ones((N,), jnp.float32), mask)
+
+    def sample(self, it, grad, hess):
+        if it < self.warmup_iters:
+            # skip the top_k work entirely on the eager path
+            return jnp.ones((self.num_data,), jnp.float32)
+        return self.mask_for_iter(it, grad, hess)
 
 
 def create_sample_strategy(config: Config, num_data: int,

@@ -291,15 +291,17 @@ def restore_trainer_state(gbdt, state: Dict[str, Any]) -> None:
       * autotune choices are PINNED from the checkpoint, never
         re-probed (probes are timing-dependent and could flip the
         kernel choice mid-model);
-      * the bagging mask live at the save point is re-derived from its
-        iteration key (``bagging_seed + it``) at the last resample
-        iteration ``floor(iter / freq) * freq``.
+      * the in-bag mask live at the save point is re-derived from its
+        iteration key (device strategies fold the floored iteration
+        ``floor(iter / period) * period`` into their PRNG key; host
+        strategies seed numpy with ``bagging_seed + floored_iter``) —
+        sampling is a pure function of the iteration, so restore needs
+        no carried mask state.
     """
     import jax.numpy as jnp
     import numpy as np
 
     from ..models.gbdt import GBDT
-    from ..models.sample_strategy import BaggingSampleStrategy
 
     if type(gbdt) is not GBDT:
         log_fatal("resume_from_checkpoint supports boosting=gbdt only")
@@ -361,10 +363,16 @@ def restore_trainer_state(gbdt, state: Dict[str, Any]) -> None:
         gbdt._build_jit_fns()
 
     strat = gbdt.sample_strategy
-    if isinstance(strat, BaggingSampleStrategy) and gbdt.iter > 0:
-        freq = max(int(gbdt.config.bagging_freq), 1)
-        it_r = (gbdt.iter // freq) * freq
-        in_bag = strat.sample(it_r, None, None)
+    if strat.resample_period() > 0 and not strat.needs_grad \
+            and gbdt.iter > 0:
+        # re-derive the in-bag mask live at the save point purely from
+        # the iteration number (sample() floors it to the last resample
+        # iteration internally) — bit-identical to the mask the saving
+        # run held, whether it trained per-iteration or in batched
+        # chunks (chunk edges align to checkpoint intervals, engine.py).
+        # Gradient-keyed strategies (GOSS) re-derive on the next
+        # boost anyway (resample_period == 1).
+        in_bag = strat.sample(gbdt.iter, None, None)
         if gbdt._host_pad != gbdt.num_data:
             in_bag = jnp.pad(
                 in_bag, (0, int(gbdt._host_pad - gbdt.num_data)))

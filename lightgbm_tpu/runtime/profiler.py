@@ -249,6 +249,36 @@ class StageProfiler:
     def add_counter(self, name: str, value: float) -> None:
         self.counters[name] = self.counters.get(name, 0.0) + value
 
+    def record_batched_chunk(self, n_iters: int, wall_s: float,
+                             n_rows: int = 0, **fields: Any) -> None:
+        """Synthesize per-iteration ring records for a host-free scan
+        chunk (models/gbdt.py:train_iters_batched, docs/PERF.md §7). One
+        scan launch covers ``n_iters`` boosting iterations with no host
+        boundary to span-time, so the chunk wall time is attributed
+        evenly across its iterations under a single "scan" stage and
+        each record carries ``batched: True`` — `device_profile=true`
+        output keeps the same {iter, wall_s, stages_s} schema either
+        path takes."""
+        if n_iters <= 0:
+            return
+        per = wall_s / n_iters
+        rows_per = int(n_rows) // n_iters
+        for _ in range(n_iters):
+            rec: Dict[str, Any] = {"iter": self.n_iters, "wall_s": per,
+                                   "stages_s": {"scan": per},
+                                   "batched": True}
+            if fields:
+                rec.update(fields)
+            self.ring.append(rec)
+            self.n_iters += 1
+            self.total_wall += per
+            self.total_rows += rows_per
+        self.totals["scan"] = self.totals.get("scan", 0.0) + wall_s
+        self.counts["scan"] = self.counts.get("scan", 0) + n_iters
+        peak = _hbm_peak_bytes()
+        if peak is not None:
+            self.hbm_peak_bytes = max(self.hbm_peak_bytes or 0, peak)
+
     HBM_SAMPLE_CAP = 4096
 
     def sample_hbm(self, tag: str = "") -> Optional[int]:

@@ -1,0 +1,126 @@
+"""Batched-training bench: host-free boosting chunks vs the
+per-iteration loop (docs/PERF.md §7).
+
+Both arms train the SAME realistic config — device-side bagging every
+iteration, one valid set with binary_logloss + auc evaluated per
+iteration, eval recording — through ``lgb.train``. The per-iteration
+arm dispatches a boost + grow (+ valid-update) jit per iteration and
+evaluates metrics on the host; the batched arm runs whole fixed-size
+``lax.scan`` chunks with in-scan sampling and metrics, replaying the
+recording callback from the stacked values afterwards. Reported per
+arm: wall seconds, total jitted dispatches (``GBDT.dispatch_count``),
+dispatches/iteration, and row-iters/s; headline leaves are the
+wall-clock ``speedup`` and the ``dispatch_reduction`` ratio. A model
+md5 cross-check and a small early-stopping arm (same stop iteration,
+same bytes, surplus trees truncated) guard that the speed came from
+orchestration, not semantics.
+
+Writes ``BENCH_BATCHED.json`` at the repo root (consumed by
+scripts/check_stale_claims.py). Also runnable as
+``BENCH_BATCHED=1 python bench.py``.
+
+Env knobs: BATCHED_ROWS (default 5000), BATCHED_COLS (12),
+BATCHED_ROUNDS (96), BATCHED_VALID_ROWS (2000).
+"""
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main() -> None:
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+
+    rows = int(os.environ.get("BATCHED_ROWS", "5000"))
+    cols = int(os.environ.get("BATCHED_COLS", "12"))
+    rounds = int(os.environ.get("BATCHED_ROUNDS", "96"))
+    vrows = int(os.environ.get("BATCHED_VALID_ROWS", "2000"))
+
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(rows, cols)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.3 * rng.normal(size=rows) > 0)
+    Xv = rng.normal(size=(vrows, cols)).astype(np.float32)
+    yv = (Xv[:, 0] + 0.5 * Xv[:, 1] + 0.3 * rng.normal(size=vrows) > 0)
+    params = dict(objective="binary", num_leaves=31, learning_rate=0.1,
+                  bagging_fraction=0.8, bagging_freq=1, seed=7,
+                  metric=["binary_logloss", "auc"], verbose=-1)
+
+    def run(batched, n_rounds=rounds, early_stop=0):
+        os.environ["LIGHTGBM_TPU_DISABLE_BATCHED"] = "" if batched else "1"
+        ds = lgb.Dataset(X, label=y.astype(np.float64))
+        vs = ds.create_valid(Xv, label=yv.astype(np.float64))
+        rec = {}
+        cbs = [lgb.record_evaluation(rec)]
+        if early_stop:
+            cbs.append(lgb.early_stopping(early_stop, verbose=False))
+        t0 = time.perf_counter()
+        booster = lgb.train(dict(params), ds, num_boost_round=n_rounds,
+                            valid_sets=[vs], valid_names=["v0"],
+                            callbacks=cbs)
+        booster._gbdt._materialize_models()   # charge tree drain to wall
+        wall = time.perf_counter() - t0
+        md5 = hashlib.md5(booster.model_to_string().encode()).hexdigest()
+        return booster, wall, md5, rec
+
+    results = {"rows": rows, "cols": cols, "rounds": rounds,
+               "chunk": 32, "arms": {}}
+
+    b_iter, wall_iter, md5_iter, _ = run(batched=False)
+    b_bat, wall_bat, md5_bat, _ = run(batched=True)
+    for name, booster, wall in (("per_iteration", b_iter, wall_iter),
+                                ("batched", b_bat, wall_bat)):
+        d = int(booster._gbdt.dispatch_count)
+        results["arms"][name] = {
+            "wall_s": round(wall, 4),
+            "dispatches": d,
+            "dispatches_per_iter": round(d / rounds, 4),
+            "row_iters_per_sec": round(rows * rounds / wall, 1),
+        }
+        print(f"{name}: {wall:.3f}s, {d} dispatches "
+              f"({d / rounds:.2f}/iter), "
+              f"{rows * rounds / wall / 1e6:.2f}M row-iters/s")
+
+    results["speedup"] = round(wall_iter / wall_bat, 2)
+    results["dispatch_reduction"] = round(
+        b_iter._gbdt.dispatch_count / max(b_bat._gbdt.dispatch_count, 1),
+        1)
+    results["parity_md5_equal"] = md5_iter == md5_bat
+    print(f"speedup {results['speedup']}x, dispatch reduction "
+          f"{results['dispatch_reduction']}x, md5 "
+          f"{'EQUAL' if results['parity_md5_equal'] else 'DIFFERENT'}")
+
+    # early-stopping arm: in-scan metrics + retroactive truncation must
+    # stop at the SAME iteration with the SAME bytes as stopping live
+    es_iter, _, es_md5_i, _ = run(batched=False, n_rounds=400,
+                                  early_stop=10)
+    es_bat, _, es_md5_b, _ = run(batched=True, n_rounds=400,
+                                 early_stop=10)
+    results["early_stop"] = {
+        "best_iteration": es_bat.best_iteration,
+        "same_best_iteration":
+            es_bat.best_iteration == es_iter.best_iteration,
+        "parity_md5_equal": es_md5_i == es_md5_b,
+    }
+    print(f"early-stop arm: best_iteration {es_bat.best_iteration} "
+          f"(same: {results['early_stop']['same_best_iteration']}), md5 "
+          f"{'EQUAL' if results['early_stop']['parity_md5_equal'] else 'DIFFERENT'}")
+
+    if not results["parity_md5_equal"] \
+            or not results["early_stop"]["parity_md5_equal"]:
+        raise SystemExit("md5 parity violated; refusing to publish bench")
+
+    out = os.path.join(ROOT, "BENCH_BATCHED.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=2, sort_keys=True)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,21 @@ import threading  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Batched scan training (the library default) compiles one extra scan
+# executable per Booster; across the suite's hundreds of tiny train()
+# calls that is minutes of pure XLA compile time for paths that are
+# md5-identical to the per-iteration loop anyway. Tier-1 therefore runs
+# the per-iteration path by default; tests/test_batched.py opts back in
+# per-test (monkeypatch) and owns batched coverage. An explicit value
+# in the environment (e.g. "0" to force batched everywhere) wins.
+os.environ.setdefault("LIGHTGBM_TPU_DISABLE_BATCHED", "1")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running test, excluded from tier-1 (-m 'not slow')")
+
 
 @pytest.fixture
 def rng():
@@ -47,14 +62,18 @@ def no_leaked_threads():
     scheduler workers ("serving-fleet*") and fused-supertensor rebuild
     threads ("fleet-fused*", serving/fleet.py) are daemons but held to
     the same standard: a leaked one keeps scoring tenants (or compiling
-    supertensors) across tests, so it fails the test too."""
+    supertensors) across tests, so it fails the test too — as is the
+    batched-training async tree drain ("gbdt-tree-drain",
+    models/gbdt.py), which engine.py must stop_drain() on every exit
+    path."""
     before = {t.ident for t in threading.enumerate()}
     yield
     fresh = [t for t in threading.enumerate()
              if t.ident not in before and t.is_alive()]
     leaked = [t for t in fresh
               if not t.daemon
-              or t.name.startswith(("serving-fleet", "fleet-fused"))]
+              or t.name.startswith(("serving-fleet", "fleet-fused",
+                                    "gbdt-tree-drain"))]
     if leaked:
         # give naturally-finishing threads a grace period before failing
         deadline = 2.0 / max(len(leaked), 1)

@@ -5,10 +5,12 @@ Crash-and-resume bit-identity: training killed mid-run (runtime/faults.py
 and resumed from its checkpoint must produce the same model md5 as an
 uninterrupted run, serially and on the 8-device virtual data-parallel
 mesh, for two checkpoint intervals. The uninterrupted baselines also run
-with checkpointing ON: the bit-identical contract is defined over the
-per-iteration training path (engine.py routes any checkpointed/resumed
-run through it; the batched-scan fast path is a different float
-schedule).
+with checkpointing ON. Fault-injected runs are routed through the
+per-iteration path (`kill@iter` fires in train_one_iter's watchdog);
+clean/resumed runs may take the batched-scan path, whose chunks are
+md5-identical to per-iteration training and whose boundaries align to
+checkpoint intervals (tests/test_batched.py), so both paths satisfy the
+same bit-identity contract.
 
 Plus: corrupt-checkpoint fallback, registry snapshot validation and
 watch-state persistence, batcher worker-death delivery, watchdog
