@@ -88,6 +88,8 @@ _alias("bin_construct_sample_cnt", "bin_construct_sample_cnt",
        "subsample_for_bin")
 _alias("data_random_seed", "data_seed")
 _alias("histogram_impl", "hist_impl", "tpu_histogram_impl")
+_alias("fused_feature_tile", "fused_tile", "grow_fused_feature_tile")
+_alias("fused_relabel_fusion", "fused_wave_fusion", "relabel_fusion")
 _alias("parallel_hist_mode", "hist_comm_mode", "parallel_histogram_mode")
 _alias("is_enable_sparse", "is_sparse", "enable_sparse", "sparse")
 _alias("enable_bundle", "is_enable_bundle", "bundle")
@@ -509,6 +511,22 @@ class Config:
     # the col-wise candidates; setting both is an error.
     histogram_impl: str = "auto"
 
+    # -- fused wave-grower geometry (ops/grow_fused.py; docs/PERF.md §6).
+    # fused_feature_tile: lane width of one feature tile in the tiled
+    # megakernel — the grid dimension that lifted the old F<=32 gate.
+    # Each tile holds a (2*tile, num_bins) VMEM accumulator per leaf, so
+    # larger tiles trade leaf capacity (kcap) for fewer grid steps.
+    # fused_relabel_fusion: fold the RELABEL pass of applies-only waves
+    # into the next wave's SPECULATE launch (tiled path only), roughly
+    # halving Pallas launches per tree. Both knobs are orchestration
+    # only — the fused scan is bitwise-identical to the two-pass wave
+    # (tests/test_grow_fused.py), so they never perturb model files.
+    # LIGHTGBM_TPU_DISABLE_FUSED=1 in the environment vetoes the fused
+    # path entirely and makes both knobs inert (the veto is recorded in
+    # device_profile extras as fused_veto_reasons).
+    fused_feature_tile: int = 32
+    fused_relabel_fusion: bool = True
+
     # -- data-parallel histogram exchange (docs/PERF.md §Communication;
     # reference: data_parallel_tree_learner.cpp ReduceScatter +
     # SyncUpGlobalBestSplit):
@@ -606,6 +624,22 @@ class Config:
                 "rowwise", "rowwise_packed"):
             log_fatal("force_col_wise conflicts with histogram_impl="
                       f"'{self.histogram_impl}'; drop one")
+        if self.fused_feature_tile not in (32, 64, 128):
+            log_fatal(
+                f"fused_feature_tile={self.fused_feature_tile} is not a "
+                "supported tile width (choose 32, 64 or 128: one VMEM "
+                "feature tile per grid step — docs/PERF.md §6)")
+        # customizing the fused geometry while pinning a non-fused
+        # histogram layout is the same contradiction class as
+        # force_row_wise + a col-wise impl: the knobs would silently do
+        # nothing (config.cpp CheckParamConflict analog)
+        if ((self.fused_feature_tile != 32
+             or not self.fused_relabel_fusion)
+                and self.histogram_impl not in ("auto", "fused")):
+            log_fatal(
+                "fused_feature_tile/fused_relabel_fusion conflict with "
+                f"histogram_impl='{self.histogram_impl}' (the fused wave "
+                "kernel is never taken under that pin); drop one")
         if self.parallel_hist_mode not in ("auto", "allreduce",
                                            "reduce_scatter"):
             log_fatal(
@@ -746,6 +780,11 @@ class Config:
         # chunked scans are md5-identical to the per-iteration loop
         # (tests/test_batched.py), so they must not perturb model files
         "batched_train", "batched_chunk_size",
+        # fused wave-grower geometry: tile width and relabel fusion are
+        # launch-scheduling choices with a bitwise-parity contract vs the
+        # two-pass wave (tests/test_grow_fused.py), so they must not
+        # perturb model files either
+        "fused_feature_tile", "fused_relabel_fusion",
         # serving overload-protection knobs describe the SERVING process,
         # not the model; keeping them out preserves the byte-identical
         # model-file contract across config changes

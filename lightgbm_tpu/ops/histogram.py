@@ -36,6 +36,16 @@ import jax.numpy as jnp
 from ..utils import round_up as _round_up
 
 
+def pallas_interpret() -> bool:
+    """LIGHTGBM_TPU_PALLAS_INTERPRET=1 routes every Pallas histogram /
+    wave kernel through the Pallas interpreter (any backend): the
+    kernel-true CPU mode the bitwise-parity suites and bench reference
+    rates use (tests/test_grow_fused.py, scripts/bench_fused.py). Read
+    at TRACE time, like the kill switch below."""
+    return os.environ.get("LIGHTGBM_TPU_PALLAS_INTERPRET", "").lower() \
+        in ("1", "true", "yes")
+
+
 def _use_pallas(X_binned_t: jnp.ndarray, num_bins: int) -> bool:
     """Fused Pallas kernel on real TPU backends; XLA lowering elsewhere
     (CPU test meshes, >8-bit bins).
@@ -48,6 +58,8 @@ def _use_pallas(X_binned_t: jnp.ndarray, num_bins: int) -> bool:
         return False
     if num_bins > 256 or X_binned_t.dtype not in (jnp.uint8, jnp.int8):
         return False
+    if pallas_interpret():
+        return True
     try:
         return jax.default_backend() == "tpu"
     except RuntimeError:
@@ -123,6 +135,7 @@ def build_histogram(
     """
     if _use_pallas(X_binned_t, num_bins):
         from .histogram_pallas import build_histogram_pallas
+        interp = pallas_interpret()
         route = _tier_route(tiers, X_binned_t.shape[0], num_bins, impl)
         if route is not None and route[0] in ("rowwise", "rowwise_packed"):
             from .histogram_rowwise import (
@@ -133,25 +146,26 @@ def build_histogram(
                     slot0 = jnp.zeros((X_binned_t.shape[1],), jnp.int32)
                     return build_histogram_slots_rowwise_packed(
                         X_binned_t, vals, slot0, 1, num_bins,
-                        route[1], route[2])[0]
+                        route[1], route[2], interpret=interp)[0]
                 return build_histogram_rowwise(X_binned_t, vals, num_bins,
-                                               route[1])
+                                               route[1], interpret=interp)
             # flat output exceeds the VMEM residency budget: col-wise
             route = _tier_route(tiers, X_binned_t.shape[0], num_bins,
                                 "auto")
         if route is None:
-            return build_histogram_pallas(X_binned_t, vals, num_bins)
+            return build_histogram_pallas(X_binned_t, vals, num_bins,
+                                          interpret=interp)
         if route[0] == "legacy":
             _, eff, wide_lo = route
             h = build_histogram_pallas(X_binned_t, vals, eff,
-                                       wide_lo=wide_lo)
+                                       wide_lo=wide_lo, interpret=interp)
             if eff < num_bins:
                 h = jnp.pad(h, ((0, 0), (0, 0), (0, num_bins - eff)))
             return h
         from .histogram_tiered import build_histogram_tiered
         _, plan, hilo = route
         return build_histogram_tiered(X_binned_t, vals, num_bins, plan,
-                                      hilo=hilo)
+                                      hilo=hilo, interpret=interp)
     return _build_histogram_xla(X_binned_t, vals, num_bins, rows_per_chunk,
                                 dtype)
 
@@ -174,6 +188,7 @@ def build_histogram_slots(
     `build_histogram` (docs/PERF.md)."""
     if _use_pallas(X_binned_t, num_bins):
         from .histogram_pallas import build_histogram_slots_pallas
+        interp = pallas_interpret()
         route = _tier_route(tiers, X_binned_t.shape[0], num_bins, impl)
         if route is not None and route[0] in ("rowwise", "rowwise_packed"):
             from .histogram_rowwise import (
@@ -183,20 +198,23 @@ def build_histogram_slots(
                 if route[0] == "rowwise_packed":
                     return build_histogram_slots_rowwise_packed(
                         X_binned_t, vals, slot, num_slots, num_bins,
-                        route[1], route[2])
+                        route[1], route[2], interpret=interp)
                 return build_histogram_slots_rowwise(
-                    X_binned_t, vals, slot, num_slots, num_bins, route[1])
+                    X_binned_t, vals, slot, num_slots, num_bins, route[1],
+                    interpret=interp)
             # wide wave: flat output exceeds the VMEM residency budget
             route = _tier_route(tiers, X_binned_t.shape[0], num_bins,
                                 "auto")
         if route is None:
             return build_histogram_slots_pallas(X_binned_t, vals, slot,
-                                                num_slots, num_bins)
+                                                num_slots, num_bins,
+                                                interpret=interp)
         if route[0] == "legacy":
             _, eff, wide_lo = route
             h = build_histogram_slots_pallas(X_binned_t, vals, slot,
                                              num_slots, eff,
-                                             wide_lo=wide_lo)
+                                             wide_lo=wide_lo,
+                                             interpret=interp)
             if eff < num_bins:
                 h = jnp.pad(h, ((0, 0), (0, 0), (0, 0),
                                 (0, num_bins - eff)))
@@ -205,7 +223,7 @@ def build_histogram_slots(
         _, plan, hilo = route
         return build_histogram_slots_tiered(X_binned_t, vals, slot,
                                             num_slots, num_bins, plan,
-                                            hilo=hilo)
+                                            hilo=hilo, interpret=interp)
     return _build_histogram_slots_xla(X_binned_t, vals, slot, num_slots,
                                       num_bins, rows_per_chunk)
 

@@ -298,6 +298,11 @@ def find_best_split(
     mono_pen_factor: jnp.ndarray | None = None,  # scalar: monotone_penalty
     #   gain multiplier for splits on monotone features
     #   (ComputeMonotoneSplitGainPenalty, monotone_constraints.hpp:358)
+    with_raw: bool = False,     # also return the RAW (pre-shift) argmax
+    #   gain — the merge key for the feature-tiled fused kernel's
+    #   cross-tile reduction (ops/grow_fused.py merge_tile_records): the
+    #   shifted gain collapses -inf/non-finite cells, the raw value is
+    #   the exact quantity the flat argmax ordered by
 ) -> SplitResult:
     """Best numerical split over all features for one leaf.
 
@@ -354,11 +359,13 @@ def find_best_split(
             (gain - min_gain_shift) * mono_pen_factor + min_gain_shift,
             gain)
 
-    return _pick_best(gain, stats, F, B, min_gain_shift)
+    return _pick_best(gain, stats, F, B, min_gain_shift,
+                      with_raw=with_raw)
 
 
-def _pick_best(gain, stats, F, B, min_gain_shift) -> SplitResult:
-    """Argmax over a filtered [2, F, B] gain map + exact stat selection."""
+def _pick_best(gain, stats, F, B, min_gain_shift, with_raw=False):
+    """Argmax over a filtered [2, F, B] gain map + exact stat selection.
+    With `with_raw` returns (SplitResult, raw_best_gain)."""
     lg, lh, lc, rg, rh, rc, lout, rout = stats
     flat = gain.reshape(-1)
     best = jnp.argmax(flat)
@@ -384,7 +391,7 @@ def _pick_best(gain, stats, F, B, min_gain_shift) -> SplitResult:
 
     picked = [pick(x) for x in (lg, lh, lc, rg, rh, rc, lout, rout)]
 
-    return SplitResult(
+    res = SplitResult(
         gain=jnp.where(jnp.isfinite(best_gain),
                        best_gain - min_gain_shift, NEG_INF),
         feature=f.astype(jnp.int32),
@@ -394,6 +401,9 @@ def _pick_best(gain, stats, F, B, min_gain_shift) -> SplitResult:
         right_sum_g=picked[3], right_sum_h=picked[4], right_count=picked[5],
         left_output=picked[6], right_output=picked[7],
     )
+    if with_raw:
+        return res, best_gain
+    return res
 
 
 def find_best_split_and_forced(

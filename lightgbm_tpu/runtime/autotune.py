@@ -74,8 +74,12 @@ _MEM_CACHE: Dict[str, Dict[str, Any]] = {}
 
 
 def make_key(n_rows: int, n_features: int, max_bin: int, num_leaves: int,
-             device_kind: str = "") -> str:
-    """Cache key over the shape signature that determines kernel choice."""
+             device_kind: str = "", variant: str = "") -> str:
+    """Cache key over the shape signature that determines kernel choice.
+
+    ``variant`` carries the fused-kernel shape signature (feature tile /
+    relabel-fusion, ``fused_variant_sig``) so a decision probed under
+    one tiling never routes a differently-tiled run."""
     if not device_kind:
         try:
             import jax
@@ -83,8 +87,23 @@ def make_key(n_rows: int, n_features: int, max_bin: int, num_leaves: int,
         except Exception:
             device_kind = "unknown"
     dk = str(device_kind).replace(" ", "_")
+    suffix = f"_{variant}" if variant else ""
     return f"r{int(n_rows)}_f{int(n_features)}_b{int(max_bin)}" \
-           f"_l{int(num_leaves)}_{dk}"
+           f"_l{int(num_leaves)}_{dk}{suffix}"
+
+
+# default fused-kernel shape signature: folded into the UNsuffixed cache
+# key so caches written before the tiled kernel existed stay valid
+_DEFAULT_FUSED_SIG = "t32rf1"
+
+
+def fused_variant_sig(cfg) -> str:
+    """Tile/variant signature of the fused megakernel configuration —
+    part of the decision-cache key (empty = the default signature)."""
+    tile = int(getattr(cfg, "fused_feature_tile", 32))
+    rf = int(bool(getattr(cfg, "fused_relabel_fusion", True)))
+    sig = f"t{tile}rf{rf}"
+    return "" if sig == _DEFAULT_FUSED_SIG else sig
 
 
 def default_cache_path() -> str:
@@ -238,47 +257,67 @@ def probe_hist_impls(X_t, cfg, impl_candidates: Sequence[str]
                      probe_rows: int = DEFAULT_PROBE_ROWS,
                      seed: int = 0,
                      timer: Callable[[], float] = time.perf_counter,
+                     num_slots: int = 8,
                      ) -> Dict[str, float]:
-    """Time ``build_histogram`` per histogram implementation candidate
-    on the real binned subsample (docs/PERF.md): the col-wise kernels
-    (legacy uniform, bin-width-tiered, hi/lo wide-bin variant) vs the
-    row-wise multi-value layout — the ``TrainingShareStates::InitTrain``
+    """Time the WAVE-shaped histogram (``build_histogram_slots`` at
+    ``num_slots`` slots) per implementation candidate on the real binned
+    subsample (docs/PERF.md): the col-wise kernels (legacy uniform,
+    bin-width-tiered, hi/lo wide-bin variant) vs the row-wise
+    multi-value layout — the ``TrainingShareStates::InitTrain``
     col-vs-row timing probe, run on device instead of estimated from
-    sparsity. Uses ``cfg.hist_tiers`` — callers gate on it being set;
-    ``impl_candidates`` narrows the field (``force_col_wise`` passes
-    ``COL_WISE_HIST_IMPLS``)."""
+    sparsity. The slot-shaped probe matters for the row-wise layouts:
+    their multi-value advantage (and their VMEM eligibility) scales with
+    the wave slot count, so a K=1 root-histogram probe both underrates
+    them and can pin a layout the wave dispatcher would silently fall
+    back from. Candidates whose dispatcher route would NOT actually run
+    at this slot count (``rowwise_eligible``) are dropped instead of
+    timing their fallback under the wrong label. Uses ``cfg.hist_tiers``
+    — callers gate on it being set; ``impl_candidates`` narrows the
+    field (``force_col_wise`` passes ``COL_WISE_HIST_IMPLS``)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from ..ops.histogram import build_histogram
+    from ..ops.histogram import _tier_route, build_histogram_slots
     from .profiler import device_barrier
 
     n = int(X_t.shape[1])
     m = max(min(int(probe_rows), n), 1)
+    K = max(int(num_slots), 1)
     Xs = jnp.asarray(jax.device_get(X_t[:, :m]))
     rng = np.random.RandomState(seed)
     vals = jnp.asarray(
         rng.uniform(-0.5, 0.5, size=(2, m)).astype(np.float32))
+    slot = jnp.asarray(rng.randint(0, K, size=m).astype(np.int32))
     B = int(cfg.num_bins_padded)
     tiers = tuple(int(t) for t in cfg.hist_tiers)
 
     timings: Dict[str, float] = {}
     for impl in impl_candidates:
+        if impl in ("rowwise", "rowwise_packed"):
+            try:
+                from ..ops.histogram_rowwise import rowwise_eligible
+                route = _tier_route(tiers, int(Xs.shape[0]), B, impl)
+                if route is None \
+                        or route[0] not in ("rowwise", "rowwise_packed") \
+                        or not rowwise_eligible(route[1], 2, K):
+                    continue      # dispatcher would fall back col-wise
+            except Exception:     # noqa: BLE001
+                continue
 
-        def run(X, v, _impl=impl):
-            return build_histogram(X, v, B,
-                                   rows_per_chunk=cfg.rows_per_chunk,
-                                   tiers=tiers, impl=_impl)
+        def run(X, v, s, _impl=impl):
+            return build_histogram_slots(X, v, s, K, B,
+                                         rows_per_chunk=cfg.rows_per_chunk,
+                                         tiers=tiers, impl=_impl)
 
         try:
             jitted = jax.jit(run)
-            _block(jitted(Xs, vals))
+            _block(jitted(Xs, vals, slot))
             best = float("inf")
             for _ in range(2):
                 device_barrier()
                 t0 = timer()
-                _block(jitted(Xs, vals))
+                _block(jitted(Xs, vals, slot))
                 best = min(best, timer() - t0)
             timings[impl] = best
         except Exception as e:                    # noqa: BLE001
@@ -295,12 +334,16 @@ def probe_fused_wave(X_t, cfg, probe_rows: int = DEFAULT_PROBE_ROWS,
     """Time one synthetic wave step both ways: the two-pass shape
     (``wave_pass_pallas`` then the XLA split search over every child)
     vs the single-launch fused megakernel with the in-kernel scan
-    (``ops/grow_fused.py:wave_pass_fused_pallas``). ``histogram_impl=
+    (``ops/grow_fused.py``). Past 32 features both arms switch shape:
+    two-pass becomes the wide wave (``wave_apply_pallas`` + the slots
+    histogram + the XLA search) and fused becomes the feature-TILED
+    megakernel (``wave_pass_fused_tiled_pallas``), so the probe times
+    the kernels the grower would actually launch. ``histogram_impl=
     "fused"`` has no plain-histogram form, so it cannot ride
     ``probe_hist_impls`` — this is its timing probe, cached in the same
     decision. Returns ``{"two_pass": s, "fused": s}``; either side
-    failing (non-TPU backend, >32 features, wide bins) drops its key and
-    the caller keeps the unfused wave."""
+    failing (non-TPU backend, wide bins) drops its key and the caller
+    keeps the unfused wave."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -313,10 +356,13 @@ def probe_fused_wave(X_t, cfg, probe_rows: int = DEFAULT_PROBE_ROWS,
     from .profiler import device_barrier
 
     F_all, n = int(X_t.shape[0]), int(X_t.shape[1])
-    F = min(F_all, 32)
     B = int(cfg.num_bins_padded)
     if B > 256:
         return {}
+    if F_all > 32:
+        return _probe_fused_wave_tiled(X_t, cfg, probe_rows=probe_rows,
+                                       seed=seed, timer=timer)
+    F = F_all
     m = max(min(int(probe_rows), n), 1)
     Xs = jnp.asarray(jax.device_get(X_t[:F, :m]))
     rng = np.random.RandomState(seed)
@@ -365,8 +411,12 @@ def probe_fused_wave(X_t, cfg, probe_rows: int = DEFAULT_PROBE_ROWS,
     meta_ops = pack_fused_meta(meta.num_bins, meta.missing_type,
                                meta.default_bin, meta.is_categorical)
 
+    from ..ops.histogram import pallas_interpret
+    _interp = pallas_interpret()
+
     def two_pass(X, v, l0):
-        new_lor, hist = wave_pass_pallas(X, v, l0, tbl16, K, B)
+        new_lor, hist = wave_pass_pallas(X, v, l0, tbl16, K, B,
+                                         interpret=_interp)
         hist = jnp.pad(hist, ((0, KMAX - K), (0, 0), (0, 0), (0, 0)))
         hs = jnp.concatenate([hist, parent - hist], axis=0)  # [2*KMAX,...]
         h3 = jax.vmap(lambda hh, c, s: synth_count_channel(hh, c, s))(
@@ -380,7 +430,8 @@ def probe_fused_wave(X_t, cfg, probe_rows: int = DEFAULT_PROBE_ROWS,
     def fused(X, v, l0):
         return wave_pass_fused_pallas(X, v, l0, tbl16,
                                       parent.reshape(KMAX, -1), scal,
-                                      meta_ops, K, B, KMAX, hp)
+                                      meta_ops, K, B, KMAX, hp,
+                                      interpret=_interp)
 
     timings: Dict[str, float] = {}
     for name, fn in (("two_pass", two_pass), ("fused", fused)):
@@ -392,6 +443,127 @@ def probe_fused_wave(X_t, cfg, probe_rows: int = DEFAULT_PROBE_ROWS,
                 device_barrier()
                 t0 = timer()
                 _block(jitted(Xs, vals, lor))
+                best = min(best, timer() - t0)
+            timings[name] = best
+        except Exception as e:                    # noqa: BLE001
+            from ..utils.log import log_warning
+            log_warning(f"autotune: fused-wave probe '{name}' failed "
+                        f"({type(e).__name__}); dropping candidate")
+    return timings
+
+
+def _probe_fused_wave_tiled(X_t, cfg, probe_rows: int = DEFAULT_PROBE_ROWS,
+                            seed: int = 0,
+                            timer: Callable[[], float] = time.perf_counter,
+                            ) -> Dict[str, float]:
+    """F > 32 arm of ``probe_fused_wave``: one synthetic wave step as
+    the wide two-pass wave (precomputed decision bits -> membership
+    kernel -> slots histogram -> XLA child search) vs the feature-tiled
+    fused megakernel. The decision-bit precompute is identical on both
+    sides, so it is built once outside the timed functions."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops.grow_fused import (pack_fused_fmask_tiled,
+                                  pack_fused_meta_tiled, pack_fused_scalars,
+                                  wave_pass_fused_tiled_pallas)
+    from ..ops.histogram import build_histogram_slots
+    from ..ops.histogram_pallas import T_ROWS, wave_apply_pallas
+    from ..ops.split import (FeatureMeta, SplitHyperParams, find_best_split,
+                             synth_count_channel)
+    from .profiler import device_barrier
+
+    F, n = int(X_t.shape[0]), int(X_t.shape[1])
+    B = int(cfg.num_bins_padded)
+    tile = int(getattr(cfg, "fused_feature_tile", 32))
+    m = max(min(int(probe_rows), n), 1)
+    Xs = jnp.asarray(jax.device_get(X_t[:, :m]))
+    rng = np.random.RandomState(seed)
+    vals = jnp.asarray(
+        rng.uniform(-0.5, 0.5, size=(2, m)).astype(np.float32))
+    K, KMAX = 4, 8
+    lor = jnp.asarray(rng.randint(0, K, size=m).astype(np.int32))
+    tiers = tuple(int(t) for t in cfg.hist_tiers[:F])
+    nb = np.clip(np.asarray(tiers + (B,) * (F - len(tiers)), np.int32),
+                 2, B)
+    thr = max(int(nb[0]) // 2 - 1, 0)
+
+    # synthetic wave table: K candidate leaves splitting feature 0 at the
+    # mid bin, no applied entries (relabel work is identical either way)
+    tbl = np.full((T_ROWS, 128), -1, np.int32)
+    tbl[7, :K] = np.arange(K)
+    tbl[15, :] = K
+    tbl16 = jnp.asarray(tbl)
+    # decision bits (the wide wave's XLA precompute; common to both arms)
+    glC = (Xs[0].astype(jnp.int32) <= thr)[None, :]          # [1, m]
+    dec8 = jnp.where(jnp.arange(128)[:, None] < K,
+                     glC.astype(jnp.int8) << 1,
+                     jnp.int8(0))                            # [128, m]
+
+    hp = SplitHyperParams(20.0, 1e-3, 0.0, 0.0, 0.0, 0.0, 0.0)
+    meta = FeatureMeta(num_bins=jnp.asarray(nb),
+                       missing_type=jnp.zeros((F,), jnp.int32),
+                       default_bin=jnp.zeros((F,), jnp.int32),
+                       is_categorical=jnp.zeros((F,), bool))
+    fmask = jnp.ones((F,), bool)
+    parent = jnp.full((KMAX, 2, F, B), float(m), jnp.float32)
+
+    class _BS:
+        left_sum_g = jnp.zeros((KMAX,), jnp.float32)
+        left_sum_h = jnp.full((KMAX,), float(m) * 0.25, jnp.float32)
+        left_count = jnp.full((KMAX,), float(m) // K, jnp.float32)
+        left_output = jnp.zeros((KMAX,), jnp.float32)
+        right_sum_g = jnp.zeros((KMAX,), jnp.float32)
+        right_sum_h = jnp.full((KMAX,), float(m) * 0.25, jnp.float32)
+        right_count = jnp.full((KMAX,), float(m) // K, jnp.float32)
+        right_output = jnp.zeros((KMAX,), jnp.float32)
+
+    sil = jnp.ones((KMAX,), jnp.float32)
+    scal = pack_fused_scalars(_BS, sil, KMAX)
+    meta_tiles = pack_fused_meta_tiled(meta.num_bins, meta.missing_type,
+                                       meta.default_bin,
+                                       meta.is_categorical, None, tile)
+    fm_tiles = pack_fused_fmask_tiled(
+        jnp.ones((2 * KMAX, F), bool), tile, KMAX)
+    pendl = jnp.full((128,), -1, jnp.int32)
+    pnl0 = jnp.asarray(0, jnp.int32)
+
+    from ..ops.histogram import pallas_interpret
+    _interp = pallas_interpret()
+
+    def two_pass(X, v, l0, d8):
+        new_lor, slot_small = wave_apply_pallas(d8, l0, tbl16,
+                                                interpret=_interp)
+        hist = build_histogram_slots(X, v, slot_small, K, B,
+                                     rows_per_chunk=cfg.rows_per_chunk,
+                                     tiers=tiers, impl="auto")
+        hist = jnp.pad(hist, ((0, KMAX - K), (0, 0), (0, 0), (0, 0)))
+        hs = jnp.concatenate([hist, parent - hist], axis=0)
+        h3 = jax.vmap(lambda hh, c, s: synth_count_channel(hh, c, s))(
+            hs, jnp.tile(_BS.left_count, 2), jnp.tile(_BS.left_sum_h, 2))
+        res = jax.vmap(lambda hh, sg, sh, c, o: find_best_split(
+            hh, sg, sh, c, o, meta, hp, fmask))(
+            h3, jnp.tile(_BS.left_sum_g, 2), jnp.tile(_BS.left_sum_h, 2),
+            jnp.tile(_BS.left_count, 2), jnp.tile(_BS.left_output, 2))
+        return new_lor, hist, res.gain
+
+    def fused(X, v, l0, d8):
+        return wave_pass_fused_tiled_pallas(
+            X, v, d8, l0, tbl16, pendl, pnl0,
+            parent.reshape(KMAX, -1), scal, meta_tiles, fm_tiles,
+            F, K, B, KMAX, hp, tile=tile, interpret=_interp)
+
+    timings: Dict[str, float] = {}
+    for name, fn in (("two_pass", two_pass), ("fused", fused)):
+        try:
+            jitted = jax.jit(fn)
+            _block(jitted(Xs, vals, lor, dec8))
+            best = float("inf")
+            for _ in range(2):
+                device_barrier()
+                t0 = timer()
+                _block(jitted(Xs, vals, lor, dec8))
                 best = min(best, timer() - t0)
             timings[name] = best
         except Exception as e:                    # noqa: BLE001
@@ -562,7 +734,8 @@ def autotune_decision(X_t, meta, cfg, candidates: Sequence[str], *,
     # "fused" never rides the plain-histogram probe list but is a valid
     # cached outcome of the fused-wave probe below
     impl_ok = (None, "fused", *impl_cands)
-    key = make_key(n_rows, n_features, max_bin, num_leaves)
+    key = make_key(n_rows, n_features, max_bin, num_leaves,
+                   variant=fused_variant_sig(cfg))
     if key in _MEM_CACHE \
             and _MEM_CACHE[key].get("hist_impl") in impl_ok:
         return dict(_MEM_CACHE[key], cached="memory")
@@ -629,6 +802,7 @@ def autotune_decision(X_t, meta, cfg, candidates: Sequence[str], *,
                               for k, v in hist_impl_timings.items()},
         "fused_wave_timings": {k: round(v, 6)
                                for k, v in fused_timings.items()},
+        "fused_variant": fused_variant_sig(cfg) or _DEFAULT_FUSED_SIG,
         "key": key,
         "probe_rows": min(int(probe_rows), int(X_t.shape[1])),
     }

@@ -487,3 +487,36 @@ def probe_stage_breakdown(X_t, grad, hess, meta, cfg,
     out["partition_s"] = round(
         timed(lambda X, t: (X[0] <= t).astype(jnp.int32), Xs, thr), 6)
     return out
+
+
+def count_pallas_launch_sites(fn: Callable, *args: Any,
+                              **kwargs: Any) -> int:
+    """Static count of Pallas kernel launch sites in ``fn``'s jaxpr.
+
+    Traces ``fn`` on the given args (abstract — nothing executes) and
+    walks every equation, recursing into sub-jaxprs (cond branches,
+    while bodies, pjit/scan calls), counting ``pallas_call`` primitives.
+    Sites inside a while body dispatch once per trip, so for the wave
+    grower this is exactly the launches-per-wave figure the relabel
+    fusion halves (docs/PERF.md §6) — the dispatch-count analog that
+    regression tests pin (tests/test_grow_fused.py)."""
+    import jax
+
+    def sub_jaxprs(params: Dict[str, Any]):
+        for v in params.values():
+            for x in (v if isinstance(v, (tuple, list)) else (v,)):
+                if hasattr(x, "eqns"):              # raw Jaxpr
+                    yield x
+                elif hasattr(x, "jaxpr"):           # ClosedJaxpr
+                    yield x.jaxpr
+
+    def walk(jaxpr) -> int:
+        n = 0
+        for eqn in jaxpr.eqns:
+            if "pallas_call" in eqn.primitive.name:
+                n += 1
+            for sj in sub_jaxprs(eqn.params):
+                n += walk(sj)
+        return n
+
+    return walk(jax.make_jaxpr(fn, **kwargs)(*args).jaxpr)

@@ -25,8 +25,20 @@ artifact checked by scripts/check_stale_claims.py):
   records interpret-mode bitwise parity rather than a rate; the
   ``device`` field says which kind of numbers you are looking at.
 
+* ``regimes`` (v2) — the broadened fused coverage: end-to-end training
+  in every regime the feature-tiled megakernel newly serves (wide F
+  with non-tile-multiple tails, quantized gradients, monotone basic,
+  interaction sets, categorical bitsets, relabel fusion off). Each
+  entry carries the kernel-true XLA reference training rate (the
+  two-pass wave the production CPU path runs) and an interpret-mode
+  bitwise parity marker from a fused-vs-two-pass train on a slice.
+  ANY parity marker reading MISMATCH makes the bench exit non-zero
+  WITHOUT printing the record: a stale-claims artifact must never
+  publish rates for a kernel that diverged.
+
 Env knobs: FUSED_ROWS (default 120000), FUSED_REPS (3),
-FUSED_SLOTS (pack4 sweep wave width, default 8).
+FUSED_SLOTS (pack4 sweep wave width, default 8),
+FUSED_REGIME_ROWS (regime sweep train rows, default 20000).
 """
 
 import json
@@ -251,6 +263,97 @@ def _pack4_sweep(rows, K, reps, on_tpu):
     return out
 
 
+def _regime_sweep(rows, reps, on_tpu):
+    """Broadened fused-regime sweep: one training config per regime the
+    tiled megakernel newly covers. Rates come from COMPILED runs at
+    `rows` (both arms on a TPU; the XLA two-pass reference elsewhere);
+    the parity marker always comes from an interpret-mode fused-vs-auto
+    train on a distinct slice (distinct shape on purpose: interpret is a
+    trace-time env knob, so the slice must never alias a compiled jit)."""
+    import lightgbm_tpu as lgb
+
+    regimes = {
+        "wide_f64": dict(F=64, extra={}),
+        "wide_f100_tail": dict(F=100, extra={}),
+        "quantized_f50": dict(F=50, extra={"use_quantized_grad": True}),
+        "monotone_basic_f40": dict(
+            F=40, extra={"monotone_constraints": [1, -1] * 20,
+                         "monotone_constraints_method": "basic"}),
+        "interaction_f40": dict(
+            F=40, extra={"interaction_constraints": [
+                list(range(14)), list(range(10, 26)),
+                list(range(24, 40))]}),
+        "categorical_f40": dict(F=40, cat=(0, 3, 7, 11),
+                                extra={"max_cat_to_onehot": 4,
+                                       "max_cat_threshold": 16}),
+        "relabel_fusion_off_f40": dict(
+            F=40, extra={"fused_relabel_fusion": False}),
+    }
+    base = {"objective": "regression", "num_leaves": 31, "max_bin": 63,
+            "min_data_in_leaf": 5, "verbose": -1, "deterministic": True}
+    rounds = 3
+    rng = np.random.RandomState(42)
+    out = {}
+    for name, spec in regimes.items():
+        F, cat = spec["F"], spec.get("cat", ())
+        X = rng.normal(size=(rows, F)).astype(np.float32)
+        for c in cat:
+            X[:, c] = rng.randint(0, 9, size=rows)
+        y = (X[:, 0] - 0.5 * X[:, F // 2]
+             + np.sin(X[:, 1])).astype(np.float32)
+
+        def _ds(Xa, ya):
+            return (lgb.Dataset(Xa, label=ya,
+                                categorical_feature=list(cat))
+                    if cat else lgb.Dataset(Xa, label=ya))
+
+        def _train(impl, Xa, ya, r=rounds, **over):
+            p = dict(base, histogram_impl=impl, **spec["extra"], **over)
+            return lgb.train(p, _ds(Xa, ya), num_boost_round=r)
+
+        entry = {"features": F, "rows": rows, "num_boost_round": rounds}
+        best = float("inf")
+        for _ in range(max(reps - 1, 1)):
+            t0 = time.perf_counter()
+            _train("fused" if on_tpu else "auto", X, y)
+            best = min(best, time.perf_counter() - t0)
+        key = ("fused_train_rows_per_sec" if on_tpu
+               else "xla_ref_train_rows_per_sec")
+        entry[key] = round(rows * rounds / best, 1)
+        if on_tpu:
+            t0 = time.perf_counter()
+            _train("auto", X, y)
+            entry["two_pass_train_rows_per_sec"] = round(
+                rows * rounds / (time.perf_counter() - t0), 1)
+
+        # interpret mode pays per-row interpreter cost, so the parity
+        # train runs a small slice at a lighter tree geometry — parity
+        # is a bit test, not a rate
+        m = min(rows, 512)
+        prev = os.environ.get("LIGHTGBM_TPU_PALLAS_INTERPRET")
+        os.environ["LIGHTGBM_TPU_PALLAS_INTERPRET"] = "1"
+        try:
+            pf = _train("fused", X[:m], y[:m], r=2,
+                        num_leaves=15).predict(X[:m])
+            pa = _train("auto", X[:m], y[:m], r=2,
+                        num_leaves=15).predict(X[:m])
+        finally:
+            if prev is None:
+                os.environ.pop("LIGHTGBM_TPU_PALLAS_INTERPRET", None)
+            else:
+                os.environ["LIGHTGBM_TPU_PALLAS_INTERPRET"] = prev
+        entry["fused_parity"] = ("bitwise" if np.array_equal(pf, pa)
+                                 else "MISMATCH")
+        out[name] = entry
+    return out
+
+
+def _has_mismatch(node) -> bool:
+    if isinstance(node, dict):
+        return any(_has_mismatch(v) for v in node.values())
+    return node == "MISMATCH"
+
+
 def main() -> None:
     rows = int(os.environ.get("FUSED_ROWS", "120000"))
     K = int(os.environ.get("FUSED_SLOTS", "8"))
@@ -264,12 +367,27 @@ def main() -> None:
         backend = "none"
     on_tpu = backend == "tpu"
 
-    print(json.dumps({
+    # the record IS stdout: silence the Info logger (its sink is stdout,
+    # and train-time lines would corrupt the one-line JSON artifact)
+    from lightgbm_tpu.utils.log import set_verbosity
+    set_verbosity(-1)
+
+    regime_rows = int(os.environ.get("FUSED_REGIME_ROWS", "20000"))
+    record = {
         "metric": "fused_wave_and_pack4",
+        "version": 2,
         "device": backend,
         "wave": _wave_sweep(rows, reps, on_tpu),
+        "regimes": _regime_sweep(regime_rows, reps, on_tpu),
         "pack4": _pack4_sweep(rows, K, reps, on_tpu),
-    }))
+    }
+    if _has_mismatch(record):
+        import sys
+        sys.stderr.write(
+            "bench_fused: bitwise parity MISMATCH — refusing to publish "
+            f"rates for a diverged kernel:\n{json.dumps(record)}\n")
+        raise SystemExit(2)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -367,3 +367,177 @@ def test_decision_cache_accepts_fused(tmp_path):
     # and a second call rides the memory cache
     assert at.autotune_decision(X_t, None, FakeCfg, (),
                                 **kw)["cached"] == "memory"
+
+
+# ---------------------------------------------------------------------------
+# feature-tiled megakernel: the same bit-identity contract past F <= 32 and
+# in every regime the fused path used to veto (quantized gradients,
+# monotone basic, interaction sets, categorical bitsets), exercised
+# end-to-end through the grower with every Pallas kernel interpreted.
+# ---------------------------------------------------------------------------
+
+INTERP = "LIGHTGBM_TPU_PALLAS_INTERPRET"
+TILED_BASE = {"objective": "regression", "num_leaves": 15, "max_bin": 31,
+              "min_data_in_leaf": 5, "verbose": -1, "deterministic": True}
+
+
+def _tiled_parity(monkeypatch, F, extra=None, max_bin=31, n=500,
+                  rounds=2, cat_cols=(), seed=3):
+    """Train histogram_impl='fused' vs the two-pass wave ('auto') with
+    identical data and require byte-identical predictions: the tiled
+    megakernel runs the real relabel/histogram/search tracers on its
+    VMEM accumulators, so any divergence is a kernel bug."""
+    monkeypatch.setenv(INTERP, "1")
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    for c in cat_cols:
+        X[:, c] = rng.randint(0, 9, size=n)
+    y = (X[:, 0] - 0.5 * X[:, F // 2] + np.sin(X[:, 1])).astype(np.float32)
+    preds = {}
+    for impl in ("auto", "fused"):
+        p = dict(TILED_BASE, histogram_impl=impl, max_bin=max_bin,
+                 **(extra or {}))
+        ds = (lgb.Dataset(X, label=y, categorical_feature=list(cat_cols))
+              if cat_cols else lgb.Dataset(X, label=y))
+        preds[impl] = lgb.train(p, ds, num_boost_round=rounds).predict(X)
+    np.testing.assert_array_equal(preds["auto"], preds["fused"])
+
+
+@pytest.mark.parametrize("F", [33, 64, 100])
+def test_tiled_parity_wide(F, monkeypatch):
+    """Tile-multiple and tail widths: 33 (1 tile + 1-col tail), 64
+    (exactly 2 tiles), 100 (3 tiles + 4-col tail)."""
+    _tiled_parity(monkeypatch, F, n=400)
+
+
+def test_tiled_parity_wide_bins_tail(monkeypatch):
+    # 255 features (7 full tiles + 31-wide tail) on the 256-lane bin axis
+    _tiled_parity(monkeypatch, 255, max_bin=255, n=300, rounds=1)
+
+
+def test_tiled_parity_quantized(monkeypatch):
+    _tiled_parity(monkeypatch, 50, extra={"use_quantized_grad": True},
+                  n=400)
+
+
+def test_tiled_parity_monotone_basic(monkeypatch):
+    mc = [1, -1] * 20
+    _tiled_parity(monkeypatch, 40,
+                  extra={"monotone_constraints": mc,
+                         "monotone_constraints_method": "basic"}, n=400)
+
+
+def test_tiled_parity_interaction_sets(monkeypatch):
+    sets = [list(range(0, 14)), list(range(10, 26)), list(range(24, 40))]
+    _tiled_parity(monkeypatch, 40,
+                  extra={"interaction_constraints": sets}, n=400)
+
+
+def test_tiled_parity_categorical(monkeypatch):
+    _tiled_parity(monkeypatch, 40, cat_cols=(0, 3, 7, 11),
+                  extra={"max_cat_to_onehot": 4,
+                         "max_cat_threshold": 16}, n=400)
+
+
+def test_tiled_parity_relabel_fusion_off(monkeypatch):
+    """fused_relabel_fusion=false keeps the separate wave_apply relabel
+    launch; results must not move either way."""
+    _tiled_parity(monkeypatch, 40,
+                  extra={"fused_relabel_fusion": False}, n=400)
+
+
+def test_relabel_fusion_cuts_launch_sites(monkeypatch):
+    """Launches-per-tree regression gate (the dispatch_count analog):
+    folding the RELABEL pass of applies-only waves into the next
+    SPECULATE launch must remove its Pallas site from the wave body."""
+    monkeypatch.setenv(INTERP, "1")
+    from lightgbm_tpu.ops.grow_wave import grow_tree_wave
+    from lightgbm_tpu.runtime.profiler import count_pallas_launch_sites
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(400, 40)).astype(np.float32)
+    y = X[:, 0].astype(np.float32)
+    bst = lgb.train(dict(TILED_BASE, histogram_impl="fused"),
+                    lgb.Dataset(X, label=y), num_boost_round=1)
+    g = bst._gbdt
+    n = int(g.X_t.shape[1])
+    grad = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    hess = jnp.ones((n,), jnp.float32)
+    bag = jnp.ones((n,), jnp.float32)
+
+    def sites(cfg):
+        return count_pallas_launch_sites(
+            lambda: grow_tree_wave(g.X_t, grad, hess, bag, g.meta, cfg))
+
+    on = sites(g.grow_cfg._replace(hist_impl="fused",
+                                   fused_relabel_fusion=True))
+    off = sites(g.grow_cfg._replace(hist_impl="fused",
+                                    fused_relabel_fusion=False))
+    assert on > 0
+    assert on < off
+
+
+def test_fused_observability_extras(monkeypatch):
+    """Every train records WHY the fused path is (in)eligible: empty
+    veto list + launch geometry when it runs, the veto reasons when it
+    silently would not."""
+    monkeypatch.setenv(INTERP, "1")
+    rng = np.random.RandomState(1)
+    X = rng.normal(size=(400, 40)).astype(np.float32)
+    y = X[:, 0].astype(np.float32)
+    p = dict(TILED_BASE, histogram_impl="fused", device_profile=True)
+    bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=1)
+    prof = bst._gbdt.profiler
+    assert prof.extras["fused_veto_reasons"] == []
+    fused = prof.extras["fused"]
+    assert fused["path"] == "fused_tiled"
+    assert fused["feature_tile"] == 32 and fused["feature_tiles"] == 2
+    assert fused["relabel_fusion"] is True
+    assert "fused" in prof.to_dict()
+    assert bst._gbdt.grow_cfg.fused_feature_tile == 32
+
+    monkeypatch.setenv("LIGHTGBM_TPU_DISABLE_FUSED", "1")
+    bst2 = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=1)
+    vetoes = bst2._gbdt.profiler.extras["fused_veto_reasons"]
+    assert "LIGHTGBM_TPU_DISABLE_FUSED" in vetoes
+
+
+def test_fused_config_knobs():
+    from lightgbm_tpu.config import Config, resolve_params
+    from lightgbm_tpu.utils.log import FatalError
+    assert resolve_params({"fused_tile": 64}).fused_feature_tile == 64
+    assert not resolve_params(
+        {"relabel_fusion": False}).fused_relabel_fusion
+    with pytest.raises(FatalError):
+        Config(fused_feature_tile=48)
+    # customizing fused geometry under a non-fused histogram pin is the
+    # force_row_wise contradiction class: fail fast, don't no-op
+    with pytest.raises(FatalError):
+        Config(fused_feature_tile=64, histogram_impl="rowwise")
+    with pytest.raises(FatalError):
+        Config(fused_relabel_fusion=False, histogram_impl="tiered")
+    Config(histogram_impl="rowwise")      # defaults: no contradiction
+    Config(fused_feature_tile=128, histogram_impl="fused")
+    # orchestration-only: excluded from the model-file parameter echo
+    echo = Config().to_string()
+    assert "fused_feature_tile" not in echo
+    assert "fused_relabel_fusion" not in echo
+
+
+def test_fused_variant_sig_keys_decision_cache():
+    """Non-default tile/fusion settings must produce a DIFFERENT cache
+    key (a decision probed at one geometry must not leak into another),
+    while the default signature keeps the historical unsuffixed keys."""
+    from lightgbm_tpu.runtime import autotune as at
+
+    class Cfg:
+        fused_feature_tile = 32
+        fused_relabel_fusion = True
+
+    assert at.fused_variant_sig(Cfg) == ""
+    Cfg.fused_feature_tile = 64
+    sig = at.fused_variant_sig(Cfg)
+    assert sig == "t64rf1" and sig != at._DEFAULT_FUSED_SIG
+    k0 = at.make_key(1000, 10, 255, 31)
+    assert at.make_key(1000, 10, 255, 31, variant="") == k0
+    k1 = at.make_key(1000, 10, 255, 31, variant=sig)
+    assert k1 != k0 and k1.endswith("_" + sig)

@@ -277,7 +277,8 @@ def test_dispatch_falls_back_when_ineligible(monkeypatch):
     from lightgbm_tpu.ops import histogram_tiered as HT
     monkeypatch.setattr(
         HT, "build_histogram_slots_tiered",
-        lambda X, v, s, K, B, plan, hilo=True: ("colwise", K))
+        lambda X, v, s, K, B, plan, hilo=True, interpret=False:
+        ("colwise", K))
     out = H.build_histogram_slots(jnp.asarray(X), jnp.asarray(vals),
                                   jnp.asarray(slot), k_big, B,
                                   tiers=nbins, impl="rowwise")
