@@ -23,6 +23,10 @@ from .models.gbdt import GBDT
 from .objectives import create_objective
 from .utils.log import log_fatal, log_info, log_warning
 
+# streaming device bin table "not yet resolved" marker (None is the
+# meaningful "host path" answer, so it can't double as the sentinel)
+_UNRESOLVED = object()
+
 
 def _is_arrow(data: Any) -> bool:
     mod = type(data).__module__
@@ -487,7 +491,20 @@ class Dataset:
         h.metadata = md
         self._handle = h
         self._stream_pos = 0
+        self._stream_table = _UNRESOLVED
         return self
+
+    def _stream_bin_table(self):
+        """Packed train-mode device bin table for streaming pushes
+        (ops/bucketize.py), resolved once per init_streaming from the
+        dataset's ``binning_impl`` knob; None = host per-feature
+        value_to_bin (docs/PERF.md §8)."""
+        if self._stream_table is _UNRESOLVED:
+            from .data.dataset import ingest_bin_table
+            cfg = resolve_params(self.params)
+            self._stream_table = ingest_bin_table(
+                self._handle, cfg, self._handle.num_data)
+        return self._stream_table
 
     def push_rows(self, data, label=None, weight=None, init_score=None,
                   start_row: Optional[int] = None) -> "Dataset":
@@ -505,10 +522,21 @@ class Dataset:
         if hi > h.num_data:
             log_fatal(f"push_rows overflows the dataset "
                       f"({hi} > {h.num_data})")
-        for inner, (m, orig) in enumerate(zip(h.mappers,
-                                              h.real_feature_index)):
-            h.X_binned[lo:hi, inner] = m.value_to_bin(
-                np.asarray(batch[:, orig], np.float64))
+        # f32 batches bucketize on device when the mapper set packs
+        # (bit-identical to the host loop); f64 always stays host
+        table = self._stream_bin_table() \
+            if batch.dtype == np.float32 else None
+        if table is not None:
+            from .ops.bucketize import bin_rows_device
+            raw = np.ascontiguousarray(batch[:, h.real_feature_index],
+                                       np.float32)
+            h.X_binned[lo:hi, :] = bin_rows_device(raw, table).astype(
+                h.X_binned.dtype)
+        else:
+            for inner, (m, orig) in enumerate(zip(h.mappers,
+                                                  h.real_feature_index)):
+                h.X_binned[lo:hi, inner] = m.value_to_bin(
+                    np.asarray(batch[:, orig], np.float64))
         if label is not None:
             h.metadata.label[lo:hi] = _to_1d_numpy(label)
         if weight is not None:

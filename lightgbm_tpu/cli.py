@@ -457,6 +457,7 @@ def run_serve_fleet(params: Dict[str, Any], cfg) -> None:
         session_opts=dict(
             engine=cfg.serve_engine, min_bucket=cfg.serve_min_bucket,
             num_shards=cfg.serve_num_shards, warmup=cfg.serve_warmup,
+            binning_impl=cfg.binning_impl,
             start_iteration=cfg.start_iteration_predict,
             num_iteration=cfg.num_iteration_predict),
         admission_opts=dict(
@@ -560,6 +561,7 @@ def run_serve(params: Dict[str, Any], cfg) -> None:
         metrics=metrics, engine=cfg.serve_engine,
         max_batch=cfg.serve_max_batch, min_bucket=cfg.serve_min_bucket,
         num_shards=cfg.serve_num_shards, warmup=cfg.serve_warmup,
+        binning_impl=cfg.binning_impl,
         start_iteration=cfg.start_iteration_predict,
         num_iteration=cfg.num_iteration_predict,
         breaker=breaker, fault_plan=fault_plan)
@@ -708,6 +710,7 @@ def run_online(params: Dict[str, Any], cfg) -> None:
             metrics=metrics, engine=cfg.serve_engine,
             max_batch=cfg.serve_max_batch, min_bucket=cfg.serve_min_bucket,
             num_shards=cfg.serve_num_shards, warmup=cfg.serve_warmup,
+            binning_impl=cfg.binning_impl,
             start_iteration=cfg.start_iteration_predict,
             num_iteration=cfg.num_iteration_predict,
             breaker=breaker, fault_plan=fault_plan, profiler=profiler)

@@ -88,6 +88,7 @@ _alias("bin_construct_sample_cnt", "bin_construct_sample_cnt",
        "subsample_for_bin")
 _alias("data_random_seed", "data_seed")
 _alias("histogram_impl", "hist_impl", "tpu_histogram_impl")
+_alias("binning_impl", "bin_impl", "tpu_binning_impl")
 _alias("fused_feature_tile", "fused_tile", "grow_fused_feature_tile")
 _alias("fused_relabel_fusion", "fused_wave_fusion", "relabel_fusion")
 _alias("parallel_hist_mode", "hist_comm_mode", "parallel_histogram_mode")
@@ -511,6 +512,20 @@ class Config:
     # the col-wise candidates; setting both is an error.
     histogram_impl: str = "auto"
 
+    # -- raw-value -> bin-id assignment (ops/bucketize.py;
+    # docs/PERF.md §8). Host mappers always FIND the bin edges; this
+    # knob picks where the value->bin push runs:
+    #   auto    device on TPU backends (autotune may refine by probing
+    #           both arms), host elsewhere
+    #   host    per-feature numpy searchsorted (the reference path)
+    #   device  packed bin table + Pallas/XLA bucketize, bit-identical
+    #           to host for f32 inputs (f64 inputs always stay host)
+    # Engages at Dataset ingest, online window refresh, and the
+    # raw-f32 serving entry (bucketize fused into the tree-walk
+    # launch). LIGHTGBM_TPU_DISABLE_DEVICE_BINNING=1 vetoes the device
+    # path everywhere without a config edit.
+    binning_impl: str = "auto"
+
     # -- fused wave-grower geometry (ops/grow_fused.py; docs/PERF.md §6).
     # fused_feature_tile: lane width of one feature tile in the tiled
     # megakernel — the grid dimension that lifted the old F<=32 gate.
@@ -609,6 +624,11 @@ class Config:
                 f"Unknown histogram_impl '{self.histogram_impl}' "
                 "(supported: 'auto', 'legacy', 'tiered', 'tiered_hilo', "
                 "'rowwise', 'rowwise_packed', 'fused'; see docs/PERF.md)")
+        if self.binning_impl not in ("auto", "host", "device"):
+            log_fatal(
+                f"Unknown binning_impl '{self.binning_impl}' "
+                "(supported: 'auto', 'host', 'device'; see "
+                "docs/PERF.md §8)")
         # the reference rejects the contradictory pair the same way
         # (config.cpp CheckParamConflict)
         if self.force_col_wise and self.force_row_wise:
@@ -785,6 +805,11 @@ class Config:
         # two-pass wave (tests/test_grow_fused.py), so they must not
         # perturb model files either
         "fused_feature_tile", "fused_relabel_fusion",
+        # binning_impl picks WHERE the value->bin push runs; the device
+        # bucketize is bit-identical to the host searchsorted
+        # (tests/test_predict_binned.py parity suites), so it must not
+        # perturb model files
+        "binning_impl",
         # serving overload-protection knobs describe the SERVING process,
         # not the model; keeping them out preserves the byte-identical
         # model-file contract across config changes

@@ -27,6 +27,55 @@ BIN_TYPE_NUMERICAL = 0
 BIN_TYPE_CATEGORICAL = 1
 
 
+# ----------------------------------------------------------------------
+# Canonical bin-assignment kernels. These two functions are THE host
+# binning semantics: BinMapper.value_to_bin (train/ingest) and
+# BinnedModel.bin_rows / export BinTable.bin_rows (serve) delegate
+# here, and export/runtime.py carries a byte-for-byte VENDORED copy
+# (it must stay import-standalone) that
+# tests/test_predict_binned.py::TestHostBinningDedupe md5-locks
+# against these. Edit all copies together.
+# ----------------------------------------------------------------------
+def numeric_value_to_bin(values: np.ndarray, bin_upper_bound: np.ndarray,
+                         missing_type: int) -> np.ndarray:
+    """Numeric raw f64 values -> bin ids against inclusive upper bounds
+    (reference: BinMapper::ValueToBin, bin.h:613-651). ``num_bin`` ==
+    ``len(bin_upper_bound)``; under MISSING_NAN the last bound is the
+    NaN sentinel and NaN rows take bin ``num_bin - 1``, otherwise NaN
+    collapses to the bin of 0.0."""
+    values = np.asarray(values, np.float64)
+    nan_mask = np.isnan(values)
+    num_bin = len(bin_upper_bound)
+    v = np.where(nan_mask, 0.0, values)
+    if missing_type == MISSING_NAN:
+        # searchsorted over upper bounds: first bound >= value -> bin;
+        # the NaN sentinel bound (last) is excluded from the search
+        bins = np.searchsorted(bin_upper_bound[:-1], v, side="left")
+        # value == bound goes in that bin (upper bounds are inclusive)
+        bins = np.minimum(bins, num_bin - 2)
+        bins = np.where(nan_mask, num_bin - 1, bins)
+    else:
+        bins = np.searchsorted(bin_upper_bound, v, side="left")
+        bins = np.minimum(bins, num_bin - 1)
+    return bins.astype(np.int32)
+
+
+def categorical_to_bin_sentinel(values: np.ndarray, keys: np.ndarray,
+                                vals: np.ndarray,
+                                num_bin: int) -> np.ndarray:
+    """Serving-side categorical raw f64 values -> bin ids with sentinel
+    semantics: NaN / negative / unseen categories map to ``num_bin``
+    (the per-feature sentinel bin every bin-domain bitset sends right).
+    ``keys`` must be sorted int64; ``vals`` the matching bin ids."""
+    col = np.asarray(values, np.float64)
+    nanm = np.isnan(col)
+    valid = ~nanm & (col >= 0)
+    iv = np.where(valid, col, 0).astype(np.int64)
+    pos = np.clip(np.searchsorted(keys, iv), 0, len(keys) - 1)
+    hit = valid & (keys[pos] == iv)
+    return np.where(hit, vals[pos], num_bin).astype(np.int64)
+
+
 def _next_after(x: float) -> float:
     """std::nextafter(x, +inf) (reference: common.h GetDoubleUpperBound:857)."""
     return math.nextafter(x, math.inf)
@@ -335,21 +384,8 @@ class BinMapper:
         bins = self._native_value_to_bin(values)
         if bins is not None:
             return bins
-        nan_mask = np.isnan(values)
-        if self.missing_type == MISSING_NAN:
-            v = np.where(nan_mask, 0.0, values)
-            # searchsorted over upper bounds: first bound >= value -> bin;
-            # the NaN sentinel bound (last) is excluded from the search
-            ub = self.bin_upper_bound[:-1]
-            bins = np.searchsorted(ub, v, side="left")
-            # value == bound goes in that bin (upper bounds are inclusive)
-            bins = np.minimum(bins, self.num_bin - 2)
-            bins = np.where(nan_mask, self.num_bin - 1, bins)
-        else:
-            v = np.where(nan_mask, 0.0, values)
-            bins = np.searchsorted(self.bin_upper_bound, v, side="left")
-            bins = np.minimum(bins, self.num_bin - 1)
-        return bins.astype(np.int32)
+        return numeric_value_to_bin(values, self.bin_upper_bound,
+                                    self.missing_type)
 
     def _native_value_to_bin(self, values: np.ndarray):
         """OpenMP value->bin for large numeric columns (lgbtpu_value_to_bin

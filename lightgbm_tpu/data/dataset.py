@@ -435,6 +435,41 @@ def _alloc_binned(ds: BinnedDataset) -> np.ndarray:
     return np.zeros((ds.num_data, max(len(ds.mappers), 1)), dtype=dtype)
 
 
+def ingest_bin_table(ds: BinnedDataset, config: Config, n_rows: int):
+    """Device-ingest gate (docs/PERF.md §8): resolve ``binning_impl``
+    (autotune-refined when the knob stayed "auto") and pack the
+    train-mode bin table over ``ds.mappers``; None keeps the host
+    per-feature ``value_to_bin`` loop. Callers additionally require f32
+    raw input — binning f64 on device could round away precision the
+    host path keeps, so f64 always stays host."""
+    from ..ops.bucketize import (BinningUnavailable, pack_bin_table,
+                                 resolve_binning_impl)
+    if not ds.mappers:
+        return None
+    impl = None
+    if config.binning_impl == "auto" and config.autotune:
+        from ..runtime.autotune import autotune_binning_decision
+        decision = autotune_binning_decision(
+            ds.mappers, n_rows=n_rows, n_features=len(ds.mappers),
+            max_bin=config.max_bin, num_leaves=config.num_leaves,
+            cache_path=config.autotune_cache,
+            seed=int(config.seed or 0))
+        impl = decision.get("binning_impl")
+        if impl:
+            log_info(f"autotune: binning probe picked "
+                     f"binning_impl='{impl}'")
+    if impl is None:
+        impl = resolve_binning_impl(config.binning_impl)
+    if impl != "device":
+        return None
+    try:
+        return pack_bin_table(ds.mappers, mode="train")
+    except BinningUnavailable as e:
+        log_warning(f"device binning unavailable ({e}); falling back "
+                    "to host binning")
+        return None
+
+
 def _finalize(ds: BinnedDataset, config: Config,
               label, weight, group, init_score,
               reference: Optional[BinnedDataset]) -> BinnedDataset:
@@ -493,11 +528,22 @@ def construct_from_matrix(
                           lambda j: sample[:, j], len(sample),
                           categorical_feature)
 
-    # push rows: vectorized value->bin per feature
+    # push rows: device bucketize when the raw matrix is f32 and the
+    # mapper set packs (bit-identical to the host loop — docs/PERF.md
+    # §8); per-feature vectorized value->bin on host otherwise
     X = _alloc_binned(ds)
-    for inner, (m, orig) in enumerate(zip(ds.mappers, ds.real_feature_index)):
-        col = np.asarray(data[:, orig], dtype=np.float64)
-        X[:, inner] = m.value_to_bin(col).astype(X.dtype)
+    table = ingest_bin_table(ds, config, num_data) \
+        if data.dtype == np.float32 else None
+    if table is not None:
+        from ..ops.bucketize import bin_rows_device
+        raw = np.ascontiguousarray(data[:, ds.real_feature_index],
+                                   np.float32)
+        X[:, :] = bin_rows_device(raw, table).astype(X.dtype)
+    else:
+        for inner, (m, orig) in enumerate(zip(ds.mappers,
+                                              ds.real_feature_index)):
+            col = np.asarray(data[:, orig], dtype=np.float64)
+            X[:, inner] = m.value_to_bin(col).astype(X.dtype)
     ds.X_binned = X
     if config.linear_tree:
         ds.raw_data = np.ascontiguousarray(data, dtype=np.float32)

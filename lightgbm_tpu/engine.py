@@ -290,7 +290,11 @@ def warm_continue(params: Dict[str, Any], X, label,
     (online/trainer.py) and, deliberately, the same function the
     offline parity baselines call: one code path, byte-identical
     models for identical inputs (tests/test_online.py)."""
-    X = np.asarray(X, np.float64)
+    # f32 windows stay f32 so push_rows can take the device bucketize
+    # (bit-identical to host binning the f64 upcast — docs/PERF.md §8)
+    X = np.asarray(X)
+    if X.dtype != np.float32:
+        X = np.asarray(X, np.float64)
     ds = Dataset(None, params=copy.deepcopy(params))
     ds.init_streaming(X.shape[0], reference=reference)
     ds.push_rows(X, label=label, weight=weight)

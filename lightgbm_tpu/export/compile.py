@@ -126,6 +126,47 @@ def roundtrip_binned_scorer(bm, K: int, bucket: int) -> Callable:
     return jax.jit(jax_export.deserialize(bytearray(exp.serialize())).call)
 
 
+def _export_raw_bucket(bm, table, K: int, bucket: int,
+                       with_leaves: bool):
+    """jax.export the FUSED bucketize+walk for one bucket shape: raw
+    f32 rows in, margins (and leaves) out — the ``bin_and_score``
+    artifact entry point. The bucketize uses the XLA reference
+    (portable StableHLO; no Pallas custom calls in the artifact), which
+    is bit-identical to the host bin_rows + binned walk."""
+    import jax
+    from jax import export as jax_export
+
+    from ..ops.bucketize import bucketize_rows
+
+    pa = bm.device_arrays()
+    T, F = bm.T, bm.num_features
+
+    if with_leaves:
+        def score(Xf):              # [b, F] f32 -> ([K, b] f32, [b, T])
+            Xb = bucketize_rows(Xf, table, impl="xla")
+            gl = predict_leaves_binned(pa, Xb)
+            lv = pa.leaf_value[gl]
+            return lv.reshape(bucket, T // K, K).sum(axis=1).T, gl
+    else:
+        def score(Xf):              # [b, F] f32 -> [K, b] f32
+            Xb = bucketize_rows(Xf, table, impl="xla")
+            return predict_margin_binned(pa, Xb, K)
+
+    spec = jax.ShapeDtypeStruct((bucket, F), np.float32)
+    return jax_export.export(jax.jit(score))(spec)
+
+
+def roundtrip_raw_scorer(bm, table, K: int, bucket: int) -> Callable:
+    """The raw-f32 flavor of :func:`roundtrip_binned_scorer`: one
+    bucket's fused bucketize+walk, exported, serialized, deserialized
+    and jitted — the ``engine="compiled"`` raw-ladder builder."""
+    import jax
+    from jax import export as jax_export
+
+    exp = _export_raw_bucket(bm, table, K, bucket, with_leaves=False)
+    return jax.jit(jax_export.deserialize(bytearray(exp.serialize())).call)
+
+
 def _bin_table_arrays(bm) -> dict:
     """The frozen BinMapper bin-edge tables, flattened into plain numpy
     arrays the standalone runtime's :class:`~.runtime.BinTable` rebuilds
@@ -209,6 +250,25 @@ def export_model(model, out_dir: str, *, bin_mappers: Optional[List] = None,
         platforms = list(exp.platforms)
         _write(f"bucket_{b}.stablehlo", bytes(exp.serialize()))
 
+    # bin_and_score entry point (docs/PERF.md §8): when the mapper set
+    # packs into a device bin table, each bucket also ships a fused
+    # bucketize+walk executable so compiled serving can consume raw f32
+    # with no host binning stage. Old artifacts simply lack these files
+    # (the loader falls back to host bin_rows + bucket_{b}).
+    bin_and_score = False
+    from ..ops.bucketize import BinningUnavailable, pack_bin_table
+    try:
+        table = pack_bin_table(bm._mappers, mode="serve",
+                               num_features=bm.num_features,
+                               used_features=bm.used_features)
+        for b in ladder:
+            exp = _export_raw_bucket(bm, table, K, b, with_leaves=True)
+            _write(f"bin_score_{b}.stablehlo", bytes(exp.serialize()))
+        bin_and_score = True
+    except BinningUnavailable as e:
+        log_info(f"export: bin_and_score entry point skipped ({e}); "
+                 "artifact serves uint8 bins only")
+
     manifest = {
         "format": FORMAT,
         "K": int(K),
@@ -222,6 +282,7 @@ def export_model(model, out_dir: str, *, bin_mappers: Optional[List] = None,
         "transform": transform,
         "sigmoid": sigmoid,
         "num_trees": int(bm.T),
+        "bin_and_score": bin_and_score,
         "jax_version": jax.__version__,
         "platforms": platforms,
         "files": files,

@@ -213,6 +213,18 @@ class FusedScorer:
         self.min_bucket = bucket_for(
             max(int(min_bucket), self.num_shards or 1), 1, self.max_batch)
         self._jit = None
+        # cross-tenant device binning (docs/PERF.md §8): when EVERY
+        # tenant session resolved a serve-mode bin table, stack them
+        # into one [C, F_pad, B] super table so all-f32 mixed batches
+        # bucketize inside the fused walk launch — the last per-request
+        # host Python stage gone from the fleet drain
+        self._stacked = None
+        self._raw_jit = None
+        tables = [getattr(sessions[n], "_bin_table", None)
+                  for n in self.forest.names]
+        if tables and all(t is not None for t in tables):
+            from ..ops.bucketize import stack_bin_tables
+            self._stacked = stack_bin_tables(tables)
         self.build_s = 0.0
         t0 = time.perf_counter()
         if warmup:
@@ -240,6 +252,34 @@ class FusedScorer:
                 self._jit = jax.jit(score)
         return self._jit
 
+    def _raw_fn(self):
+        """Raw-f32 fused drain: per-row tenant-table bucketize + the
+        fused walk in ONE jitted launch ([n, Fmax] f32 + [n] tid ->
+        [Kmax, n]); bit-identical to per-tenant host bin_rows + the
+        uint8 path."""
+        if self._raw_jit is None:
+            import jax
+
+            from ..ops.bucketize import bucketize_rows_stacked
+            fa = self.forest.device_arrays()
+            num_cat, W, Kmax, ItersMax = (
+                self.forest.num_cat, self.forest.W, self.forest.Kmax,
+                self.forest.ItersMax)
+            st = self._stacked
+
+            def score(Xf, tid):      # [n, Fmax] f32, [n] i32 -> [K, n]
+                Xb = bucketize_rows_stacked(Xf, st, tid)
+                return predict_margin_fused(fa, num_cat, W, Kmax,
+                                            ItersMax, Xb, tid)
+
+            if self._mesh is not None:
+                from ..parallel import build_sharded_score_fn
+                self._raw_jit = build_sharded_score_fn(
+                    self._mesh, score, extra_row_args=1)
+            else:
+                self._raw_jit = jax.jit(score)
+        return self._raw_jit
+
     def warmup(self) -> List[int]:
         """Compile the whole bucket ladder BEFORE the scorer is
         published, so a supertensor swap never makes live traffic pay a
@@ -254,6 +294,11 @@ class FusedScorer:
             out = fn(np.zeros((b, self.forest.Fmax), np.uint8),
                      np.zeros(b, np.int32))
             jax.block_until_ready(out)
+            if self._stacked is not None:
+                out = self._raw_fn()(
+                    np.zeros((b, self.forest.Fmax), np.float32),
+                    np.zeros(b, np.int32))
+                jax.block_until_ready(out)
         log_info(f"fused scorer gen={self.generation} warm: "
                  f"tenants={len(self.forest.names)} buckets={ladder} "
                  f"shards={self.num_shards or 1}")
@@ -269,17 +314,27 @@ class FusedScorer:
         n = sum(g[1].shape[0] for g in groups)
         from ..serving.session import bucket_for
         b = bucket_for(n, self.min_bucket, self.max_batch)
-        Xb = np.zeros((b, self.forest.Fmax), np.uint8)
+        # all-f32 batches against a stacked bin table ship RAW: the
+        # per-row tenant-table bucketize runs inside the walk launch
+        raw = self._stacked is not None and all(
+            np.asarray(X).dtype == np.float32 for _, X in groups)
+        Xb = np.zeros((b, self.forest.Fmax),
+                      np.float32 if raw else np.uint8)
         tid = np.zeros(b, np.int32)
         off = 0
         for name, X in groups:
             bm = self.sessions[name]._bm
             m = X.shape[0]
-            Xb[off:off + m, :bm.num_features] = bm.bin_rows(X)
+            if raw:
+                Xb[off:off + m, :bm.num_features] = \
+                    np.asarray(X)[:, :bm.num_features]
+            else:
+                Xb[off:off + m, :bm.num_features] = bm.bin_rows(X)
             tid[off:off + m] = self.forest.tid_of[name]
             off += m
         import jax
-        out = np.asarray(jax.device_get(self._fn()(Xb, tid)))   # [Kmax, b]
+        fn = self._raw_fn() if raw else self._fn()
+        out = np.asarray(jax.device_get(fn(Xb, tid)))           # [Kmax, b]
         results = []
         off = 0
         for name, X in groups:

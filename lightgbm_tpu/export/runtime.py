@@ -65,6 +65,51 @@ def file_sha256(path):
     return h.hexdigest()
 
 
+# ----------------------------------------------------------------------
+# VENDORED canonical bin-assignment kernels. Byte-for-byte copies of
+# data/binning.py numeric_value_to_bin / categorical_to_bin_sentinel
+# (modulo the leading underscore and MISSING constant spelling) — this
+# module must stay import-standalone, so it cannot import them.
+# tests/test_predict_binned.py::TestHostBinningDedupe md5-locks the
+# two copies against each other; edit both together.
+# ----------------------------------------------------------------------
+def _numeric_value_to_bin(values, bin_upper_bound, missing_type):
+    """Numeric raw f64 values -> bin ids against inclusive upper bounds
+    (reference: BinMapper::ValueToBin, bin.h:613-651). ``num_bin`` ==
+    ``len(bin_upper_bound)``; under MISSING_NAN the last bound is the
+    NaN sentinel and NaN rows take bin ``num_bin - 1``, otherwise NaN
+    collapses to the bin of 0.0."""
+    values = np.asarray(values, np.float64)
+    nan_mask = np.isnan(values)
+    num_bin = len(bin_upper_bound)
+    v = np.where(nan_mask, 0.0, values)
+    if missing_type == _MISSING_NAN:
+        # searchsorted over upper bounds: first bound >= value -> bin;
+        # the NaN sentinel bound (last) is excluded from the search
+        bins = np.searchsorted(bin_upper_bound[:-1], v, side="left")
+        # value == bound goes in that bin (upper bounds are inclusive)
+        bins = np.minimum(bins, num_bin - 2)
+        bins = np.where(nan_mask, num_bin - 1, bins)
+    else:
+        bins = np.searchsorted(bin_upper_bound, v, side="left")
+        bins = np.minimum(bins, num_bin - 1)
+    return bins.astype(np.int32)
+
+
+def _categorical_to_bin_sentinel(values, keys, vals, num_bin):
+    """Serving-side categorical raw f64 values -> bin ids with sentinel
+    semantics: NaN / negative / unseen categories map to ``num_bin``
+    (the per-feature sentinel bin every bin-domain bitset sends right).
+    ``keys`` must be sorted int64; ``vals`` the matching bin ids."""
+    col = np.asarray(values, np.float64)
+    nanm = np.isnan(col)
+    valid = ~nanm & (col >= 0)
+    iv = np.where(valid, col, 0).astype(np.int64)
+    pos = np.clip(np.searchsorted(keys, iv), 0, len(keys) - 1)
+    hit = valid & (keys[pos] == iv)
+    return np.where(hit, vals[pos], num_bin).astype(np.int64)
+
+
 class BinTable:
     """Frozen per-feature binning tables: raw f64 rows -> uint8 bin
     indices, replicating ``BinnedModel.bin_rows`` (and through it
@@ -91,27 +136,12 @@ class BinTable:
         n = X.shape[0]
         out = np.zeros((n, self.num_features), np.uint8)
         for f, (ub, missing_type) in self.numeric.items():
-            col = np.asarray(X[:, f], np.float64)
-            nan_mask = np.isnan(col)
-            num_bin = len(ub)
-            if missing_type == _MISSING_NAN:
-                v = np.where(nan_mask, 0.0, col)
-                bins = np.searchsorted(ub[:-1], v, side="left")
-                bins = np.minimum(bins, num_bin - 2)
-                bins = np.where(nan_mask, num_bin - 1, bins)
-            else:
-                v = np.where(nan_mask, 0.0, col)
-                bins = np.searchsorted(ub, v, side="left")
-                bins = np.minimum(bins, num_bin - 1)
-            out[:, f] = bins.astype(np.uint8)
+            out[:, f] = _numeric_value_to_bin(
+                X[:, f], ub, missing_type).astype(np.uint8)
         for f, (keys, vals, num_bin) in self.categorical.items():
-            col = np.asarray(X[:, f], np.float64)
-            nanm = np.isnan(col)
-            valid = ~nanm & (col >= 0)
-            iv = np.where(valid, col, 0).astype(np.int64)
-            pos = np.clip(np.searchsorted(keys, iv), 0, len(keys) - 1)
-            hit = valid & (keys[pos] == iv)
-            out[:, f] = np.where(hit, vals[pos], num_bin).astype(np.uint8)
+            out[:, f] = _categorical_to_bin_sentinel(
+                X[:, f], np.asarray(keys, np.int64),
+                np.asarray(vals, np.int64), num_bin).astype(np.uint8)
         return out
 
 
@@ -133,7 +163,13 @@ class CompiledModel:
         self.buckets = [int(b) for b in manifest["buckets"]]
         self.min_bucket = int(manifest["min_bucket"])
         self.max_batch = int(manifest["max_batch"])
+        # artifacts with the fused bucketize+walk entry point carry one
+        # bin_score_<b>.stablehlo per bucket: raw f32 rows in, margins +
+        # leaves out, no host binning stage. Older artifacts lack the
+        # flag and serve uint8 bins only.
+        self.bin_and_score = bool(manifest.get("bin_and_score", False))
         self._fns = {}                                 # bucket -> callable
+        self._raw_fns = {}
 
     # ------------------------------------------------------------------
     @classmethod
@@ -175,6 +211,21 @@ class CompiledModel:
             self._fns[bucket] = fn
         return fn
 
+    def _raw_fn(self, bucket):
+        """Deserialize (once) and jit-wrap the bucket's fused
+        bucketize+walk executable (bin_and_score entry point)."""
+        fn = self._raw_fns.get(bucket)
+        if fn is None:
+            import jax
+            from jax import export as jax_export
+            with open(os.path.join(self.path,
+                                   f"bin_score_{bucket}.stablehlo"),
+                      "rb") as f:
+                exp = jax_export.deserialize(bytearray(f.read()))
+            fn = jax.jit(exp.call)
+            self._raw_fns[bucket] = fn
+        return fn
+
     def warmup(self):
         """Pre-execute every bucket so no live request pays a
         deserialize/compile; returns the bucket ladder."""
@@ -182,14 +233,26 @@ class CompiledModel:
         for b in self.buckets:
             out = self._fn(b)(np.zeros((b, self.num_features), np.uint8))
             jax.block_until_ready(out)
+            if self.bin_and_score:
+                out = self._raw_fn(b)(np.zeros((b, self.num_features),
+                                               np.float32))
+                jax.block_until_ready(out)
         return list(self.buckets)
 
     # ------------------------------------------------------------------
     def _run(self, X):
         """Chunk/bucket/pad exactly like the serving session; yields
-        (c0, c1, margins_f32 [K, m], leaves_i32 [m, T])."""
+        (c0, c1, margins_f32 [K, m], leaves_i32 [m, T]).
+
+        f32 input against a ``bin_and_score`` artifact skips host
+        binning entirely: the chunk ships raw and the executable's
+        fused bucketize (bit-identical to ``BinTable.bin_rows``) feeds
+        the walk. Everything else binned on host as before."""
         import jax
-        X = np.ascontiguousarray(np.asarray(X, np.float64))
+        X = np.asarray(X)
+        raw_f32 = X.dtype == np.float32 and self.bin_and_score
+        X = np.ascontiguousarray(X if raw_f32
+                                 else np.asarray(X, np.float64))
         if X.ndim == 1:
             X = X.reshape(1, -1)
         n = X.shape[0]
@@ -197,9 +260,14 @@ class CompiledModel:
             c1 = min(c0 + self.max_batch, n)
             m = c1 - c0
             b = bucket_for(m, self.min_bucket, self.max_batch)
-            Xp = np.zeros((b, self.num_features), np.uint8)
-            Xp[:m] = self.bins.bin_rows(X[c0:c1])
-            m32, gl = self._fn(b)(Xp)
+            if raw_f32:
+                Xp = np.zeros((b, self.num_features), np.float32)
+                Xp[:m] = X[c0:c1, :self.num_features]
+                m32, gl = self._raw_fn(b)(Xp)
+            else:
+                Xp = np.zeros((b, self.num_features), np.uint8)
+                Xp[:m] = self.bins.bin_rows(X[c0:c1])
+                m32, gl = self._fn(b)(Xp)
             m32, gl = jax.device_get((m32, gl))
             yield c0, c1, np.asarray(m32)[:, :m], np.asarray(gl)[:m]
 
@@ -207,7 +275,7 @@ class CompiledModel:
         """[K, n] f64 raw margins: the executable routes (leaf indices),
         the f64 leaf table accumulates — bit-identical to
         ``Booster.predict(raw_score=True)``."""
-        X = np.asarray(X, np.float64)
+        X = np.asarray(X)             # _run normalizes dtype per path
         n = X.shape[0] if X.ndim > 1 else 1
         out = np.empty((self.K, n), np.float64)
         for c0, c1, _m32, gl in self._run(X):
@@ -222,7 +290,7 @@ class CompiledModel:
         """[K, n] f64-cast f32-accumulated margins straight from the
         executable — bit-identical to ``engine="binned"`` /
         ``engine="compiled"`` serving sessions."""
-        X = np.asarray(X, np.float64)
+        X = np.asarray(X)             # _run normalizes dtype per path
         n = X.shape[0] if X.ndim > 1 else 1
         out = np.empty((self.K, n), np.float64)
         for c0, c1, m32, _gl in self._run(X):

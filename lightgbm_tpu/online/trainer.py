@@ -130,7 +130,12 @@ class OnlineTrainer:
     # -- sliding window -------------------------------------------------
 
     def _append(self, b: MicroBatch) -> None:
-        self._wX.append(np.asarray(b.X, np.float64))
+        # f32 streams keep their dtype through the window so the
+        # refresh's warm_continue/refit can rebin on device
+        # (ops/bucketize.py — bit-identical to the host f64 path)
+        bX = np.asarray(b.X)
+        self._wX.append(bX if bX.dtype == np.float32
+                        else np.asarray(bX, np.float64))
         self._wy.append(np.asarray(b.y, np.float64))
         self._ww.append(None if b.weight is None
                         else np.asarray(b.weight, np.float64))

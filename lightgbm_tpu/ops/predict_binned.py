@@ -277,25 +277,20 @@ class BinnedModel:
         Categorical NaN / negative / unseen values map to the
         per-feature sentinel bin (num_bin), which every bin-domain
         bitset sends right."""
-        from ..data.binning import BIN_TYPE_CATEGORICAL
+        from ..data.binning import (BIN_TYPE_CATEGORICAL,
+                                    categorical_to_bin_sentinel)
         n = X.shape[0]
         out = np.zeros((n, self.num_features), np.uint8)
         for f in self.used_features:
             mp = self._mappers[f]
             col = np.asarray(X[:, f], np.float64)
             if mp.bin_type == BIN_TYPE_CATEGORICAL:
-                nanm = np.isnan(col)
-                valid = ~nanm & (col >= 0)
-                iv = np.where(valid, col, 0).astype(np.int64)
                 keys = np.array(sorted(mp.categorical_2_bin), np.int64)
                 vals = np.array(
                     [mp.categorical_2_bin[k] for k in keys.tolist()],
                     np.int64)
-                pos = np.clip(np.searchsorted(keys, iv), 0,
-                              len(keys) - 1)
-                hit = valid & (keys[pos] == iv)
-                out[:, f] = np.where(hit, vals[pos],
-                                     mp.num_bin).astype(np.uint8)
+                out[:, f] = categorical_to_bin_sentinel(
+                    col, keys, vals, mp.num_bin).astype(np.uint8)
             else:
                 out[:, f] = mp.value_to_bin(col).astype(np.uint8)
         return out

@@ -696,6 +696,110 @@ def pin_comm_decision(*, n_rows: int, n_features: int, max_bin: int,
     return decision
 
 
+def probe_binning(mappers, *, probe_rows: int = 16384, seed: int = 0,
+                  timer: Callable[[], float] = time.perf_counter,
+                  ) -> Dict[str, float]:
+    """Time the two value->bin arms on synthetic f32 rows from a fixed
+    seed: ``host`` is the per-feature numpy ``value_to_bin`` loop every
+    host site runs, ``device`` is the packed-table bucketize
+    (ops/bucketize.py) as one jitted launch. Both arms bin the same
+    rows; the device arm is bit-identical by construction, so the probe
+    only decides where the work runs. Returns an empty dict (caller
+    keeps the untuned default) when the mapper set is not
+    device-packable."""
+    import numpy as np
+
+    from ..ops.bucketize import (BinningUnavailable, bucketize_rows,
+                                 pack_bin_table)
+    from .profiler import device_barrier
+
+    try:
+        table = pack_bin_table(mappers, mode="train")
+    except BinningUnavailable:
+        return {}
+    rng = np.random.RandomState(seed)
+    n = max(int(probe_rows), 256)
+    X = rng.uniform(-100.0, 100.0,
+                    size=(n, len(mappers))).astype(np.float32)
+
+    timings: Dict[str, float] = {}
+
+    def host_arm() -> None:
+        for f, m in enumerate(mappers):
+            if m is not None and not getattr(m, "is_trivial", False):
+                m.value_to_bin(np.asarray(X[:, f], np.float64))
+
+    try:
+        best = float("inf")
+        host_arm()                                 # warm numpy caches
+        for _ in range(2):
+            t0 = timer()
+            host_arm()
+            best = min(best, timer() - t0)
+        timings["host"] = best
+    except Exception as e:                         # noqa: BLE001
+        from ..utils.log import log_warning
+        log_warning(f"autotune: host binning probe failed "
+                    f"({type(e).__name__}); dropping candidate")
+    try:
+        import jax
+        jitted = jax.jit(lambda Xc: bucketize_rows(Xc, table))
+        _block(jitted(X))                          # compile + warm
+        best = float("inf")
+        for _ in range(2):
+            device_barrier()
+            t0 = timer()
+            _block(jitted(X))
+            best = min(best, timer() - t0)
+        timings["device"] = best
+    except Exception as e:                         # noqa: BLE001
+        from ..utils.log import log_warning
+        log_warning(f"autotune: device binning probe failed "
+                    f"({type(e).__name__}); dropping candidate")
+    return timings
+
+
+def autotune_binning_decision(mappers, *, n_rows: int, n_features: int,
+                              max_bin: int, num_leaves: int,
+                              cache_path: str = "", seed: int = 0,
+                              timer: Callable[[], float]
+                              = time.perf_counter,
+                              ) -> Dict[str, Any]:
+    """Resolve ``binning_impl=auto`` by a timed probe, cached under the
+    standard shape key with a ``_binning`` suffix. On a tie the
+    backend's untuned "auto" resolution wins, so a tie reproduces
+    untuned behavior (the histogram-impl contract). Returns
+    ``{"binning_impl", "binning_timings", "key", "cached"}``;
+    ``binning_impl`` is None when both arms failed or the mapper set is
+    not packable (caller falls back to the host path)."""
+    from ..ops.bucketize import resolve_binning_impl
+
+    key = make_key(n_rows, n_features, max_bin, num_leaves) + "_binning"
+    if key in _MEM_CACHE:
+        return dict(_MEM_CACHE[key], cached="memory")
+    path = cache_path or default_cache_path()
+    disk = load_disk_cache(path)
+    hit = disk.get(key)
+    if isinstance(hit, dict) and hit.get("binning_impl") in (
+            None, "host", "device"):
+        _MEM_CACHE[key] = hit
+        return dict(hit, cached="disk")
+
+    timings = probe_binning(mappers, seed=seed, timer=timer)
+    default = resolve_binning_impl("auto")
+    preference = (default, "host" if default == "device" else "device")
+    impl = _pick_winner(timings, preference)
+    decision: Dict[str, Any] = {
+        "binning_impl": impl,
+        "binning_timings": {n: round(v, 6) for n, v in timings.items()},
+        "key": key,
+    }
+    _MEM_CACHE[key] = decision
+    disk[key] = decision
+    save_disk_cache(path, disk)
+    return dict(decision, cached=False)
+
+
 def _pick_winner(timings: Dict[str, float],
                  preference: Sequence[str]) -> Optional[str]:
     """Fastest candidate; ties within TIE_TOL resolve by preference

@@ -197,7 +197,8 @@ class ModelRegistry:
         if old is None:
             return self.register(name, model, **session_opts)
         opts = dict(session_opts)
-        for k in ("engine", "max_batch", "min_bucket", "num_shards"):
+        for k in ("engine", "max_batch", "min_bucket", "num_shards",
+                  "binning_impl"):
             opts.setdefault(k, getattr(
                 old, k if k != "engine" else "requested_engine"))
         # the breaker (and any fault plan / coexistence profiler) is

@@ -104,7 +104,8 @@ class ServingSession:
                  num_iteration: int = -1, warmup: bool = False,
                  metrics: Optional[ServingMetrics] = None,
                  version: int = 0, breaker=None, fault_plan=None,
-                 profiler=None, bin_mappers=None) -> None:
+                 profiler=None, bin_mappers=None,
+                 binning_impl: str = "auto") -> None:
         self.gbdt = gbdt
         # graceful-degradation circuit breaker (serving/breaker.py):
         # guards the device scoring path; shared across hot-swapped
@@ -144,6 +145,25 @@ class ServingSession:
         self.max_batch = 1 << max(int(max_batch) - 1, 0).bit_length()
         self.requested_engine = engine
         self.engine = self._resolve_engine(engine)
+        # raw-f32 fused serving (docs/PERF.md §8): a serve-mode device
+        # bin table lets f32 requests bucketize IN the scoring launch —
+        # no host bin_rows stage. Host/f64 requests are untouched.
+        self.binning_impl = binning_impl
+        self._bin_table = None
+        self._raw_jit = None
+        if self.engine in ("binned", "compiled"):
+            from ..ops.bucketize import (BinningUnavailable,
+                                         pack_bin_table,
+                                         resolve_binning_impl)
+            if resolve_binning_impl(binning_impl) == "device":
+                try:
+                    self._bin_table = pack_bin_table(
+                        self._bm._mappers, mode="serve",
+                        num_features=self._bm.num_features,
+                        used_features=self._bm.used_features)
+                except BinningUnavailable as e:
+                    log_warning(f"serving: device binning unavailable "
+                                f"({e}); f32 requests bin on host")
         self.metrics = metrics if metrics is not None else ServingMetrics(
             max_batch=self.max_batch)
         if self.metrics.max_batch == 0:
@@ -285,6 +305,30 @@ class ServingSession:
         # they ride the same cache so hit-rate accounting is uniform
         return self._pm.predict_margin
 
+    def _raw_scorer(self, bucket: int) -> Callable:
+        """Raw-f32 fused scorer: bucketize + bin-domain walk in ONE
+        jitted launch — f32 [b, F] raw rows -> [K, b] margins with no
+        host binning stage. Bit-identical to host bin_rows + the binned
+        walk (the bucketize parity contract, ops/bucketize.py)."""
+        if self.engine == "compiled":
+            from ..export.compile import roundtrip_raw_scorer
+            return roundtrip_raw_scorer(self._bm, self._bin_table,
+                                        self.K, bucket)
+        if self._raw_jit is None:
+            import jax
+            from ..ops.bucketize import bucketize_rows
+            from ..ops.predict_binned import predict_margin_binned
+            pa = self._bm.device_arrays()
+            K = self.K
+            t = self._bin_table
+
+            def score(Xp):                   # [b, F] f32 raw -> [K, b]
+                return predict_margin_binned(pa, bucketize_rows(Xp, t),
+                                             K)
+
+            self._raw_jit = jax.jit(score)
+        return self._raw_jit
+
     def warmup(self) -> List[int]:
         """Pre-compile the whole bucket ladder (min_bucket..max_batch,
         powers of two) before traffic lands, so no live request pays a
@@ -306,6 +350,15 @@ class ServingSession:
                 import jax
                 out = fn(np.zeros((b, self._bm.num_features), np.uint8))
                 jax.block_until_ready(out)
+                if self._bin_table is not None:
+                    # warm the raw-f32 fused ladder alongside the
+                    # uint8 one: live traffic may arrive either way
+                    rfn = self._cache.get(
+                        (self.version, self.engine + "_raw", b),
+                        lambda b=b: self._raw_scorer(b))
+                    out = rfn(np.zeros((b, self.num_features),
+                                       np.float32))
+                    jax.block_until_ready(out)
         log_info(f"serving warmup: engine={self.engine} "
                  f"buckets={ladder} shards={self.num_shards or 1}")
         return ladder
@@ -337,7 +390,32 @@ class ServingSession:
                              lambda b=b: self._build_scorer(b))
         m = c1 - c0
         Xp = np.zeros((b, self._bm.num_features), np.uint8)
-        Xp[:m] = self._bm.bin_rows(X[c0:c1])
+        if self.profiler is not None:
+            with self.profiler.span("bin_rows"):
+                Xp[:m] = self._bm.bin_rows(X[c0:c1])
+            self.profiler.add_counter("bin_rows_rows", m)
+            self.profiler.add_counter("bin_rows_bytes_in",
+                                      X[c0:c1].nbytes)
+            self.profiler.add_counter("bin_rows_bytes_out", Xp[:m].nbytes)
+        else:
+            Xp[:m] = self._bm.bin_rows(X[c0:c1])
+        return np.asarray(jax.device_get(fn(Xp)))[:, :m].astype(np.float64)
+
+    def _score_binned_raw(self, X: np.ndarray, c0: int, c1: int,
+                          b: int) -> np.ndarray:
+        """Raw-f32 fused path: the chunk ships as f32 and the bucketize
+        runs INSIDE the scoring launch (one program raw features ->
+        margins; no host bin_rows stage, no separate binning launch)."""
+        import jax
+        fn = self._cache.get((self.version, self.engine + "_raw", b),
+                             lambda b=b: self._raw_scorer(b))
+        m = c1 - c0
+        Xp = np.zeros((b, self.num_features), np.float32)
+        Xp[:m] = X[c0:c1, :self.num_features]
+        if self.profiler is not None:
+            self.profiler.add_counter("bin_rows_fused_rows", m)
+            self.profiler.add_counter("bin_rows_fused_bytes_in",
+                                      Xp[:m].nbytes)
         return np.asarray(jax.device_get(fn(Xp)))[:, :m].astype(np.float64)
 
     def score_margin(self, X: np.ndarray) -> np.ndarray:
@@ -352,8 +430,17 @@ class ServingSession:
         ``Booster.predict``, counted as ``host_fallbacks``) until a
         half-open probe succeeds. A device failure mid-chunk is recorded
         and the chunk is re-scored on the host, so a flaky device never
-        surfaces as a client error while the host path works."""
-        X = np.ascontiguousarray(np.asarray(X, np.float64))
+        surfaces as a client error while the host path works.
+
+        f32 requests additionally keep their dtype when the session
+        holds a device bin table: those chunks skip host binning and
+        score through the fused bucketize+walk launch
+        (``_score_binned_raw``), bit-identical to the f64 path."""
+        X = np.asarray(X)
+        raw_f32 = (X.dtype == np.float32 and self._bin_table is not None
+                   and self.engine in ("binned", "compiled"))
+        X = np.ascontiguousarray(X if raw_f32
+                                 else np.asarray(X, np.float64))
         n = X.shape[0]
         out = np.empty((self.K, n), np.float64)
         for c0 in range(0, n, self.max_batch):
@@ -377,9 +464,12 @@ class ServingSession:
                 try:
                     if self.fault_plan is not None:
                         self.fault_plan.fail_score(seq)
-                    r = (self._score_binned(X, c0, c1, b)
-                         if self.engine in ("binned", "compiled")
-                         else self._score_device(X, c0, c1, b))
+                    if self.engine in ("binned", "compiled"):
+                        r = (self._score_binned_raw(X, c0, c1, b)
+                             if raw_f32
+                             else self._score_binned(X, c0, c1, b))
+                    else:
+                        r = self._score_device(X, c0, c1, b)
                     if self.breaker is not None:
                         self.breaker.record_success(
                             time.perf_counter() - t0)
@@ -389,14 +479,16 @@ class ServingSession:
                     self.metrics.inc("host_fallbacks")
                     log_warning(f"serving: {self.engine} scoring failed "
                                 f"({e!r}); chunk re-scored on host")
-                    r = self._host_fn(b)(X[c0:c1])
+                    r = self._host_fn(b)(
+                        np.asarray(X[c0:c1], np.float64))
             else:
                 if self.fault_plan is not None:
                     self.fault_plan.fail_score(seq)
                 # host path scores the exact rows (padding buys nothing
                 # without a shaped trace) — bit-identical to
-                # Booster.predict by construction
-                r = self._host_fn(b)(X[c0:c1])
+                # Booster.predict by construction; f32 raw chunks
+                # upcast so the host walk always sees f64
+                r = self._host_fn(b)(np.asarray(X[c0:c1], np.float64))
             self.metrics.record_batch(time.perf_counter() - t0, m)
             if self.profiler is not None:
                 self.profiler.sample_hbm("serve_score")
@@ -440,4 +532,5 @@ class ServingSession:
         return {"entries": len(self._cache), "hits": self._cache.hits,
                 "misses": self._cache.misses, "engine": self.engine,
                 "version": self.version,
+                "device_binning": self._bin_table is not None,
                 "num_shards": self.num_shards or 1}

@@ -1,0 +1,207 @@
+"""Device-resident binning bench (docs/PERF.md section 8).
+
+Two sweeps, one JSON line (redirect to BENCH_BINNING.json to refresh
+the committed artifact checked by scripts/check_stale_claims.py):
+
+* ``ingest`` — chunked Dataset construction rows/s: the host arm is
+  the production per-feature ``BinMapper.value_to_bin`` numpy loop
+  (f64), the device arm is the packed bin-table bucketize
+  (``ops/bucketize.py``) over the same raw f32 rows. On a TPU the
+  device arm is the Pallas kernel; elsewhere it is the kernel-true
+  XLA reference lowering the production CPU path dispatches to. Both
+  arms produce the full uint8 binned matrix; parity is checked
+  bitwise over every cell before any rate is published.
+
+* ``serving`` — end-to-end raw-f32 serving QPS through a binned
+  ``ServingSession``: the host arm binds ``binning_impl=host`` (raw
+  rows are binned on the host, then shipped), the device arm binds
+  ``binning_impl=device`` (raw f32 rows ship as-is and the bucketize
+  runs fused into the tree-walk launch). Margins from the two arms
+  are compared bitwise per batch.
+
+ANY parity marker reading MISMATCH makes the bench exit non-zero
+WITHOUT printing the record: a stale-claims artifact must never
+publish rates for a binning path that diverged from the host
+BinMapper semantics.
+
+Env knobs: BINNING_ROWS (ingest rows, default 200000),
+BINNING_FEATURES (default 64), BINNING_REPS (3),
+BINNING_SERVE_BATCH (serving batch rows, default 2048),
+BINNING_MAX_BIN (default 255).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _time_best(fn, reps):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _make_raw(rows, F, seed=42):
+    """Raw f32 rows exercising every edge the bin table must honour:
+    NaN, exact zeros (MISSING_ZERO collapse), negatives, and a
+    categorical column with negative / unseen codes."""
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(-100.0, 100.0, size=(rows, F)).astype(np.float32)
+    X[rng.rand(rows, F) < 0.02] = np.nan
+    X[rng.rand(rows, F) < 0.05] = 0.0
+    X[:, F - 1] = rng.randint(-2, 40, size=rows).astype(np.float32)
+    return X
+
+
+def _fit_mappers(X, max_bin, cat_cols):
+    from lightgbm_tpu.data.binning import (BIN_TYPE_CATEGORICAL,
+                                           BIN_TYPE_NUMERICAL, BinMapper)
+    mappers = []
+    for f in range(X.shape[1]):
+        col = np.asarray(X[:, f], np.float64)
+        mappers.append(BinMapper.find_bin(
+            col, len(col), max_bin, 3, 20,
+            bin_type=(BIN_TYPE_CATEGORICAL if f in cat_cols
+                      else BIN_TYPE_NUMERICAL)))
+    return mappers
+
+
+def _ingest_sweep(rows, F, max_bin, reps):
+    import jax
+
+    from lightgbm_tpu.ops.bucketize import (bucketize_rows,
+                                            pack_bin_table)
+
+    X = _make_raw(rows, F)
+    mappers = _fit_mappers(X[: min(rows, 50000)], max_bin, {F - 1})
+    table = pack_bin_table(mappers, mode="train")
+
+    def host_arm():
+        out = np.empty((rows, F), np.uint8)
+        for f, m in enumerate(mappers):
+            col = np.asarray(X[:, f], dtype=np.float64)
+            out[:, f] = m.value_to_bin(col).astype(np.uint8)
+        return out
+
+    jitted = jax.jit(lambda r: bucketize_rows(r, table))
+
+    def device_arm():
+        return np.asarray(jax.block_until_ready(jitted(X)))[:, :F]
+
+    ref = host_arm()
+    got = device_arm()
+    parity = "bitwise" if np.array_equal(ref, got) else "MISMATCH"
+
+    host_best = _time_best(host_arm, reps)
+    device_best = _time_best(device_arm, reps)
+    return {
+        "rows": rows, "features": F, "max_bin": max_bin,
+        "parity": parity,
+        "host_rows_per_sec": round(rows / host_best, 1),
+        "device_rows_per_sec": round(rows / device_best, 1),
+        "device_speedup": round(host_best / device_best, 4),
+    }
+
+
+def _serving_sweep(batch, F, max_bin, reps):
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serving.session import ServingSession
+
+    rows = max(batch * 2, 6000)
+    X = _make_raw(rows, F).astype(np.float64)
+    rng = np.random.RandomState(7)
+    # label touches EVERY feature so the model's split set (and with it
+    # the host arm's per-feature binning loop) spans the full table —
+    # a single-feature label would leave the host arm binning one
+    # column while the device arm searches all of them
+    w = rng.uniform(0.5, 1.5, size=F)
+    y = np.nan_to_num(X) @ w + (np.nan_to_num(X[:, F - 1]) % 3 == 0)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[F - 1],
+                     params={"verbosity": -1, "max_bin": max_bin})
+    bst = lgb.train({"objective": "regression", "num_leaves": 63,
+                     "feature_fraction": 0.9, "verbosity": -1}, ds,
+                    num_boost_round=15)
+
+    Xq = _make_raw(batch, F, seed=11)
+    s_host = ServingSession.from_booster(bst, engine="binned",
+                                         binning_impl="host",
+                                         max_batch=max(batch, 8))
+    s_dev = ServingSession.from_booster(bst, engine="binned",
+                                        binning_impl="device",
+                                        max_batch=max(batch, 8))
+    s_host.warmup()
+    s_dev.warmup()
+
+    m_host = s_host.score_margin(Xq)
+    m_dev = s_dev.score_margin(Xq)
+    parity = ("bitwise" if np.array_equal(m_host, m_dev)
+              else "MISMATCH")
+    device_binning = bool(s_dev._bin_table is not None)
+    if not device_binning:
+        parity = "MISMATCH"           # device arm silently fell back
+
+    host_best = _time_best(lambda: s_host.score_margin(Xq), reps)
+    device_best = _time_best(lambda: s_dev.score_margin(Xq), reps)
+    return {
+        "batch_rows": batch, "features": F, "max_bin": max_bin,
+        "num_trees": bst.num_trees(), "parity": parity,
+        "device_binning_active": device_binning,
+        "host_qps": round(batch / host_best, 1),
+        "raw_f32_qps": round(batch / device_best, 1),
+        "raw_f32_speedup": round(host_best / device_best, 4),
+    }
+
+
+def _has_mismatch(node) -> bool:
+    if isinstance(node, dict):
+        return any(_has_mismatch(v) for v in node.values())
+    return node == "MISMATCH"
+
+
+def main() -> None:
+    rows = int(os.environ.get("BINNING_ROWS", "200000"))
+    F = int(os.environ.get("BINNING_FEATURES", "64"))
+    reps = int(os.environ.get("BINNING_REPS", "3"))
+    batch = int(os.environ.get("BINNING_SERVE_BATCH", "2048"))
+    max_bin = int(os.environ.get("BINNING_MAX_BIN", "255"))
+
+    import jax
+
+    try:
+        backend = jax.default_backend()
+    except RuntimeError:
+        backend = "none"
+
+    # the record IS stdout: silence the Info logger (its sink is stdout,
+    # and train-time lines would corrupt the one-line JSON artifact)
+    from lightgbm_tpu.utils.log import set_verbosity
+    set_verbosity(-1)
+
+    record = {
+        "metric": "device_binning",
+        "version": 1,
+        "device": backend,
+        "ingest": _ingest_sweep(rows, F, max_bin, reps),
+        "serving": _serving_sweep(batch, F, max_bin, reps),
+    }
+    if _has_mismatch(record):
+        import sys
+        sys.stderr.write(
+            "bench_binning: bitwise parity MISMATCH — refusing to "
+            "publish rates for a diverged binning path:\n"
+            f"{json.dumps(record)}\n")
+        raise SystemExit(2)
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()

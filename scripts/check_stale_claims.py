@@ -31,7 +31,7 @@ BENCH_GLOBS = ["BENCH_EXTRAS.json", "BENCH_r*.json", "BENCH_ROWWISE.json",
                "BENCH_COMM.json", "BENCH_FUSED.json", "BENCH_RESIL.json",
                "BENCH_SLO.json", "BENCH_ONLINE.json", "BENCH_FLEET.json",
                "BENCH_EXPORT.json", "BENCH_BATCHED.json", "BASELINE.json",
-               "MULTICHIP_r*.json"]
+               "BENCH_BINNING.json", "MULTICHIP_r*.json"]
 REL_TOL = 0.05          # claims are rounded for display (700M vs 680.4M)
 SKIP_BEFORE = "≥≤<>~="  # bound / approximation markers: not measurements
 

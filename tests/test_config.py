@@ -105,3 +105,16 @@ def test_serve_fused_config():
     assert cfg.serve_fused is True and cfg.serve_fused_shards == 4
     with pytest.raises(FatalError):
         resolve_params({"serve_fused_shards": "-1"})
+
+
+def test_binning_impl_knob():
+    """binning_impl (PR 20 device-resident binning): aliases resolve,
+    bad values fail fast, and the knob stays out of the model string
+    (_NON_MODEL_FIELDS — model-file byte identity)."""
+    assert Config().binning_impl == "auto"
+    assert resolve_params({"bin_impl": "device"}).binning_impl == "device"
+    assert resolve_params({"tpu_binning_impl": "host"}).binning_impl \
+        == "host"
+    with pytest.raises(FatalError):
+        resolve_params({"binning_impl": "gpu"})
+    assert "binning_impl" not in Config(binning_impl="device").to_string()

@@ -223,3 +223,267 @@ def test_registry_promote_carries_mappers(rng, tmp_path):
     ref = ServingSession(booster._gbdt, engine="device",
                          warmup=False).predict(q)
     assert _md5(np.asarray(sess.predict(q))) == _md5(np.asarray(ref))
+
+
+# ---------------------------------------------------------------------------
+# Device-resident binning (ops/bucketize.py): kernel parity against the
+# host BinMapper path, the host-binning dedupe lock, and the categorical
+# sentinel contract across every serving surface (PR 20).
+# ---------------------------------------------------------------------------
+
+INTERP = "LIGHTGBM_TPU_PALLAS_INTERPRET"
+
+
+def _edge_col(rng, n=512):
+    """f32 numeric fixture walking the docs/PARITY.md edges: NaN, +/-0,
+    subnormals, huge magnitudes."""
+    v = rng.normal(scale=50.0, size=n).astype(np.float32)
+    v[rng.rand(n) < 0.08] = np.nan
+    v[rng.rand(n) < 0.08] = 0.0
+    v[rng.rand(n) < 0.04] = -0.0
+    v[:4] = np.array([1e-45, -1e-45, 3e38, -3e38], np.float32)
+    return v
+
+
+def _edge_mappers(rng, F, max_bin, n=2000, zero_as_missing=False):
+    """One BinMapper per column over adversarial samples (last column
+    categorical with negative codes in the fit sample)."""
+    from lightgbm_tpu.data.binning import (BIN_TYPE_CATEGORICAL,
+                                           BIN_TYPE_NUMERICAL, BinMapper)
+    X = np.stack([_edge_col(rng, n) for _ in range(F)], axis=1)
+    X[:, F - 1] = rng.randint(0, 30, size=n).astype(np.float32)
+    mappers = [
+        BinMapper.find_bin(
+            np.asarray(X[:, f], np.float64), n, max_bin, 3, 20,
+            bin_type=(BIN_TYPE_CATEGORICAL if f == F - 1
+                      else BIN_TYPE_NUMERICAL),
+            zero_as_missing=zero_as_missing)
+        for f in range(F)]
+    return mappers, X
+
+
+def _host_bin(mappers, X):
+    out = np.empty(X.shape, np.int64)
+    for f, m in enumerate(mappers):
+        out[:, f] = m.value_to_bin(np.asarray(X[:, f], np.float64))
+    return out
+
+
+class TestDeviceBucketizeParity:
+    """bucketize_rows (Pallas-interpret AND its XLA reference) must be
+    md5-identical to the host BinMapper loop on every fixture."""
+
+    @pytest.mark.parametrize("max_bin", [31, 63, 127, 255])
+    def test_bin_width_tiers(self, rng, monkeypatch, max_bin):
+        monkeypatch.setenv(INTERP, "1")
+        from lightgbm_tpu.ops.bucketize import (bucketize_rows,
+                                                pack_bin_table)
+        mappers, _ = _edge_mappers(rng, 6, max_bin)
+        t = pack_bin_table(mappers, mode="train")
+        Xq = np.stack([_edge_col(rng, 300) for _ in range(6)], axis=1)
+        Xq[:, 5] = rng.randint(-3, 40, size=300).astype(np.float32)
+        ref = _host_bin(mappers, Xq).astype(np.uint8)
+        for impl in ("xla", "pallas"):
+            got = np.asarray(bucketize_rows(Xq, t, impl=impl))[:, :6]
+            assert _md5(got) == _md5(ref), impl
+
+    def test_max_bin_255_overflow_bin(self, rng, monkeypatch):
+        """max_bin=255 + NaN sentinel -> num_bin == 256: the uint8
+        overflow tier must still round-trip bit-exactly."""
+        monkeypatch.setenv(INTERP, "1")
+        from lightgbm_tpu.data.binning import BinMapper
+        from lightgbm_tpu.ops.bucketize import (bucketize_rows,
+                                                pack_bin_table)
+        v = np.unique(rng.normal(size=4000)).astype(np.float64)[:3000]
+        v = np.concatenate([v, [np.nan] * 50])
+        m = BinMapper.find_bin(v, len(v), 256, 1, 2)
+        assert m.num_bin == 256          # NaN bin pushed past uint8 max-1
+        t = pack_bin_table([m], mode="train")
+        q = np.concatenate([v[:500], [np.nan, 0.0, -0.0, 1e30, -1e30]])
+        q = q.astype(np.float32)[:, None]
+        ref = m.value_to_bin(np.asarray(q[:, 0], np.float64))
+        got = np.asarray(bucketize_rows(q, t, impl="pallas"))[:, 0]
+        assert np.array_equal(got, ref.astype(np.uint8))
+
+    def test_trivial_constant_features(self, rng, monkeypatch):
+        """Constant / near-trivial columns bin identically (and the
+        Dataset ingest path drops trivial mappers before packing)."""
+        monkeypatch.setenv(INTERP, "1")
+        n = 400
+        X = rng.normal(size=(n, 4)).astype(np.float32)
+        X[:, 1] = 7.25                      # constant -> trivial feature
+        X[:, 2] = np.where(rng.rand(n) < 0.5, 0.0, 1.0)  # 2-bin column
+        y = np.asarray(X[:, 0], np.float64)
+        p = {"verbosity": -1, "max_bin": 63, "min_data_in_leaf": 5}
+        d_host = lgb.Dataset(np.asarray(X, np.float64), label=y,
+                             params=dict(p, binning_impl="host"))
+        d_dev = lgb.Dataset(X, label=y,
+                            params=dict(p, binning_impl="device"))
+        d_host.construct()
+        d_dev.construct()
+        assert np.array_equal(d_host._handle.X_binned,
+                              d_dev._handle.X_binned)
+
+    def test_efb_bundles_ingest_parity(self, rng, monkeypatch):
+        """One-hot (EFB-bundleable) blocks: the device ingest must
+        produce the exact binned matrix of the host per-mapper loop."""
+        monkeypatch.setenv(INTERP, "1")
+        n = 500
+        onehot = np.eye(8, dtype=np.float32)[rng.randint(0, 8, size=n)]
+        dense = rng.normal(size=(n, 4)).astype(np.float32)
+        X = np.concatenate([dense, onehot], axis=1)
+        y = np.asarray(X[:, 0] + onehot[:, 3], np.float64)
+        p = {"verbosity": -1, "max_bin": 63, "min_data_in_leaf": 5,
+             "enable_bundle": True}
+        d_host = lgb.Dataset(np.asarray(X, np.float64), label=y,
+                             params=dict(p, binning_impl="host"))
+        d_dev = lgb.Dataset(X, label=y,
+                            params=dict(p, binning_impl="device"))
+        d_host.construct()
+        d_dev.construct()
+        assert np.array_equal(d_host._handle.X_binned,
+                              d_dev._handle.X_binned)
+
+    def test_zero_as_missing_parity(self, rng, monkeypatch):
+        monkeypatch.setenv(INTERP, "1")
+        from lightgbm_tpu.ops.bucketize import (bucketize_rows,
+                                                pack_bin_table)
+        mappers, _ = _edge_mappers(rng, 4, 63, zero_as_missing=True)
+        t = pack_bin_table(mappers[:3], mode="train")   # numeric only
+        Xq = np.stack([_edge_col(rng, 300) for _ in range(3)], axis=1)
+        ref = _host_bin(mappers[:3], Xq).astype(np.uint8)
+        got = np.asarray(bucketize_rows(Xq, t, impl="pallas"))[:, :3]
+        assert _md5(got) == _md5(ref)
+
+
+class TestHostBinningDedupe:
+    """Satellite 1: ONE host binning implementation. data/binning.py is
+    canonical; ops/predict_binned.py delegates; export/runtime.py
+    vendors a byte-for-byte copy (it must stay import-standalone) that
+    this class locks against drift."""
+
+    def test_vendored_source_is_byte_identical(self):
+        import inspect
+
+        from lightgbm_tpu.data import binning as canon
+        from lightgbm_tpu.export import runtime as vend
+        pairs = [(canon.numeric_value_to_bin, vend._numeric_value_to_bin),
+                 (canon.categorical_to_bin_sentinel,
+                  vend._categorical_to_bin_sentinel)]
+        for c, v in pairs:
+            vsrc = inspect.getsource(v)
+            vsrc = vsrc.replace("def _", "def ")
+            vsrc = vsrc.replace("_MISSING_NAN", "MISSING_NAN")
+            csrc = inspect.getsource(c)
+            # strip the canonical def's type annotations for comparison
+            import re
+            csrc = re.sub(r"\(values[^)]*\)\s*->\s*np\.ndarray:",
+                          "(values, %s):" % (
+                              "bin_upper_bound, missing_type"
+                              if "numeric" in c.__name__
+                              else "keys, vals,\n"
+                              "                                num_bin"),
+                          csrc, count=1)
+            assert "".join(vsrc.split()) == "".join(csrc.split()), \
+                f"{v.__name__} drifted from canonical {c.__name__}"
+
+    def test_numeric_md5_cross_parity(self, rng):
+        from lightgbm_tpu.data.binning import numeric_value_to_bin
+        from lightgbm_tpu.export.runtime import _numeric_value_to_bin
+        for zam in (False, True):
+            mappers, _ = _edge_mappers(rng, 4, 63, zero_as_missing=zam)
+            for m in mappers[:3]:
+                col = np.asarray(_edge_col(rng, 700), np.float64)
+                a = m.value_to_bin(col)
+                b = numeric_value_to_bin(col, m.bin_upper_bound,
+                                         m.missing_type)
+                c = _numeric_value_to_bin(col, m.bin_upper_bound,
+                                          m.missing_type)
+                assert _md5(np.asarray(a, np.int64)) \
+                    == _md5(np.asarray(b, np.int64)) \
+                    == _md5(np.asarray(c, np.int64))
+
+    def test_categorical_md5_cross_parity(self, rng):
+        from lightgbm_tpu.data.binning import categorical_to_bin_sentinel
+        from lightgbm_tpu.export.runtime import _categorical_to_bin_sentinel
+        mappers, _ = _edge_mappers(rng, 2, 63)
+        m = mappers[-1]
+        keys = np.array(sorted(m.categorical_2_bin), np.int64)
+        vals = np.array([m.categorical_2_bin[k] for k in keys.tolist()],
+                        np.int32)
+        col = rng.randint(-5, 60, size=700).astype(np.float64)
+        col[rng.rand(700) < 0.1] = np.nan
+        col[:3] = (-0.0, 1000.0, 2.5)
+        a = categorical_to_bin_sentinel(col, keys, vals, m.num_bin)
+        b = _categorical_to_bin_sentinel(col, keys, vals, m.num_bin)
+        assert _md5(np.asarray(a)) == _md5(np.asarray(b))
+        # unseen/negative/NaN all landed on the sentinel
+        assert a[1] == m.num_bin and np.all(a[np.isnan(col)] == m.num_bin)
+
+
+class TestCategoricalSentinel:
+    """Satellite 2: unseen / negative categoricals land in the sentinel
+    bin (num_bin) on the host path, the device bucketize, AND the
+    exported-artifact runtime — and margins stay bit-identical."""
+
+    def test_sentinel_across_paths(self, rng, monkeypatch, tmp_path):
+        monkeypatch.setenv(INTERP, "1")
+        from lightgbm_tpu.export.compile import export_model
+        from lightgbm_tpu.export.runtime import load_compiled
+        from lightgbm_tpu.ops.bucketize import (bucketize_rows,
+                                                pack_bin_table)
+
+        n = 600
+        X = rng.normal(size=(n, COLS))
+        X[:, 2] = rng.randint(0, 12, size=n)
+        y = np.where(np.isin(X[:, 2], (1, 4, 7, 9)), 3.0, -3.0) \
+            + 0.1 * rng.normal(size=n)
+        booster = lgb.train(
+            dict(objective="regression", num_leaves=15, verbose=-1,
+                 min_data_in_leaf=5),
+            lgb.Dataset(X, label=y, categorical_feature=[2]),
+            num_boost_round=8)
+        gbdt = booster._gbdt
+        bm = build_binned_model(_pack(gbdt), mappers_for(gbdt))
+        mp = bm._mappers[2]
+        sentinel = mp.num_bin
+
+        q = _query(rng, X, n=64)
+        q[:, 2] = rng.randint(0, 12, size=64)
+        q[:8, 2] = [99, -3, -1, 1000, 7.7, -0.0, np.nan, 5]
+        # device bit-identity is an f32-input contract (docs/PARITY.md):
+        # compare every path on the same f32-representable rows
+        q = q.astype(np.float32).astype(np.float64)
+        bad = [0, 1, 2, 3, 6]            # unseen / negative / NaN rows
+
+        # host path (ops/predict_binned.bin_rows)
+        host_bins = bm.bin_rows(q)
+        assert np.all(host_bins[bad, 2] == sentinel)
+        assert host_bins[5, 2] == mp.categorical_2_bin[0]   # -0.0 is 0
+
+        # device path (serve-mode bucketize)
+        t = pack_bin_table(bm._mappers, mode="serve",
+                           num_features=bm.num_features,
+                           used_features=bm.used_features)
+        dev_bins = np.asarray(
+            bucketize_rows(np.asarray(q, np.float32), t,
+                           impl="pallas"))[:, :COLS]
+        assert np.all(dev_bins[bad, 2] == sentinel)
+        assert np.array_equal(dev_bins, host_bins)
+
+        # export path (runtime BinTable, import-standalone)
+        d = str(tmp_path / "artifact")
+        export_model(booster, d)
+        cm = load_compiled(d)
+        exp_bins = cm.bins.bin_rows(q)
+        assert np.all(exp_bins[bad, 2] == sentinel)
+        assert np.array_equal(exp_bins, host_bins)
+
+        # margins agree bit-for-bit across all three surfaces
+        ref = ServingSession(gbdt, engine="binned",
+                             warmup=False).score_margin(q)
+        raw = ServingSession(gbdt, engine="binned", warmup=False,
+                             binning_impl="device") \
+            .score_margin(np.asarray(q, np.float32))
+        exp = cm.score_margin_f32(q)    # the artifact's f32-accum twin
+        assert _md5(ref) == _md5(raw) == _md5(exp)

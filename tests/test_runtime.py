@@ -376,3 +376,34 @@ def test_cli_profile_smoke(tmp_path, capsys):
                 if s not in ("bin", "autotune")]
     ssum = sum(prof["stages_s"][s] for s in per_iter)
     assert abs(ssum - prof["total_wall_s"]) <= 0.2 * prof["total_wall_s"]
+
+
+def test_autotune_binning_decision_caches(tmp_path):
+    """binning_impl=auto probe (PR 20): decision is a valid impl, disk
+    cache round-trips, and unpackable mapper sets resolve to None
+    (caller falls back to host)."""
+    from lightgbm_tpu.data.binning import BinMapper
+
+    rng = np.random.RandomState(5)
+    mappers = [
+        BinMapper.find_bin(rng.normal(size=2000), 2000, 63, 3, 20)
+        for _ in range(4)]
+    path = str(tmp_path / "bin_cache.json")
+    d1 = at.autotune_binning_decision(
+        mappers, n_rows=2000, n_features=4, max_bin=63, num_leaves=31,
+        cache_path=path)
+    assert d1["binning_impl"] in ("host", "device")
+    assert d1["cached"] is False
+    assert d1["key"].endswith("_binning")
+    assert set(d1["binning_timings"]) == {"host", "device"}
+    d2 = at.autotune_binning_decision(
+        mappers, n_rows=2000, n_features=4, max_bin=63, num_leaves=31,
+        cache_path=path)
+    assert d2["cached"] == "memory"
+    assert d2["binning_impl"] == d1["binning_impl"]
+    at._MEM_CACHE.clear()
+    d3 = at.autotune_binning_decision(
+        mappers, n_rows=2000, n_features=4, max_bin=63, num_leaves=31,
+        cache_path=path)
+    assert d3["cached"] == "disk"
+    assert d3["binning_impl"] == d1["binning_impl"]
